@@ -1,0 +1,38 @@
+"""flexflow_tpu — a TPU-native auto-parallelizing deep-learning framework.
+
+A ground-up JAX/XLA/pallas re-design of the capabilities of FlexFlow (the
+Legion-based Unity-era auto-parallelizing DNN framework; reference layer map in
+SURVEY.md §1): a model and its parallelization are represented together as a
+Parallel Computation Graph (PCG); a search (substitutions + DP + a TPU cost
+model) picks the best hybrid strategy over a `jax.sharding.Mesh`; execution is
+one SPMD `jit`-compiled train step whose collectives XLA emits over ICI.
+
+Where the reference uses Legion regions + FFMapper + NCCL
+(reference: src/runtime/model.cc, src/mapper/mapper.cc), this framework uses
+GSPMD: a MachineView becomes an assignment of tensor dims to mesh axes, and the
+four parallel ops (Repartition/Combine/Replicate/Reduction) become reshardings.
+"""
+
+from flexflow_tpu.dtype import DataType
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.core.tensor import Tensor, TensorSpec
+from flexflow_tpu.core.model import FFModel
+from flexflow_tpu.optimizers import SGDOptimizer, AdamOptimizer
+from flexflow_tpu.losses import LossType
+from flexflow_tpu.metrics import MetricsType
+from flexflow_tpu.ops.op_type import OperatorType
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "DataType",
+    "FFConfig",
+    "FFModel",
+    "Tensor",
+    "TensorSpec",
+    "SGDOptimizer",
+    "AdamOptimizer",
+    "LossType",
+    "MetricsType",
+    "OperatorType",
+]

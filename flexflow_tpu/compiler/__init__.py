@@ -1,0 +1,3 @@
+from flexflow_tpu.compiler.compile import CompiledModel, compile_model
+
+__all__ = ["CompiledModel", "compile_model"]

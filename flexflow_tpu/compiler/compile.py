@@ -1,0 +1,291 @@
+"""compile_model — the pivot of the framework.
+
+Reference analog: FFModel::compile (src/runtime/model.cc:2803): lower layers
+→ operators, run the strategy search, materialize tensors onto the machine,
+create the label tensor, init optimizer + NCCL. The TPU-native pipeline:
+
+  1. build/machine-detect the logical Mesh            (mapper analog)
+  2. pick a Strategy: imported file > search > data-parallel
+     (graph_optimize_task analog)
+  3. trace the layer graph into one SPMD train step jitted over the mesh
+     (IndexLauncher-per-op → one XLA computation; collectives via GSPMD)
+  4. init weights directly into their target shardings
+     (region materialization analog)
+"""
+
+from __future__ import annotations
+
+import time
+from functools import partial
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from flexflow_tpu.core.graph import topo_order
+from flexflow_tpu.core.tensor import Tensor
+from flexflow_tpu.compiler.lowering import build_forward, constrainable
+from flexflow_tpu.dtype import DataType
+from flexflow_tpu.initializers import default_initializer
+from flexflow_tpu.losses import LossType, compute_loss
+from flexflow_tpu.metrics import MetricsType, PerfMetrics, compute_metrics
+from flexflow_tpu.optimizers import Optimizer, SGDOptimizer
+from flexflow_tpu.parallel.default_strategy import data_parallel_strategy
+from flexflow_tpu.parallel.machine import MachineSpec, build_mesh
+from flexflow_tpu.parallel.sharding import Strategy
+from flexflow_tpu.runtime.dataloader import SingleDataLoader, prefetch_to_device
+
+
+def _pick_strategy(model, machine: MachineSpec) -> Strategy:
+    cfg = model.config
+    if cfg.import_strategy_file:
+        return Strategy.load(cfg.import_strategy_file)
+    if cfg.search_budget > 0 and not cfg.only_data_parallel and machine.num_devices > 1:
+        try:
+            from flexflow_tpu.search.optimize import graph_optimize
+        except ImportError:
+            import warnings
+
+            warnings.warn("strategy search unavailable; falling back to data-parallel")
+        else:
+            return graph_optimize(model, machine)
+    return data_parallel_strategy(model, machine)
+
+
+def compile_model(model, optimizer, loss_type: LossType, metrics: Sequence[MetricsType],
+                  outputs: Optional[Sequence[Tensor]] = None) -> "CompiledModel":
+    cfg = model.config
+    if cfg.machine_model_file:
+        machine = MachineSpec.from_file(cfg.machine_model_file)
+    else:
+        machine = MachineSpec.detect(cfg.mesh_shape)
+    mesh = build_mesh(machine)
+    strategy = _pick_strategy(model, machine)
+    if cfg.export_strategy_file:
+        strategy.save(cfg.export_strategy_file)
+    optimizer = optimizer or SGDOptimizer(lr=cfg.learning_rate)
+    if outputs is None:
+        outputs = model.layers[-1].outputs[:1] if model.layers else []
+    return CompiledModel(model, machine, mesh, strategy, optimizer,
+                         loss_type, list(metrics), list(outputs))
+
+
+class CompiledModel:
+    def __init__(self, model, machine: MachineSpec, mesh: Mesh, strategy: Strategy,
+                 optimizer: Optimizer, loss_type: LossType,
+                 metrics: List[MetricsType], outputs: List[Tensor]):
+        self.model = model
+        self.machine = machine
+        self.mesh = mesh
+        self.strategy = strategy
+        self.optimizer = optimizer
+        self.tx = optimizer.to_optax()
+        self.loss_type = loss_type
+        self.metrics = metrics
+        self.outputs = outputs
+        self.cfg = model.config
+        self._iteration = 0
+        self.recompile_state = None  # set via recompile_on_condition
+
+        self.forward_fn = build_forward(model.layers, model.input_tensors, outputs,
+                                        mesh, strategy)
+        self._build_steps()
+        self.params = None
+        self.state: Dict[str, Any] = {}
+        self.opt_state = None
+
+    # ------------------------------------------------------------- sharding
+    def _weight_sharding(self, layer_name: str, wname: str, shape) -> NamedSharding:
+        pspec = self.strategy.sharding_for(layer_name).weight_pspec(wname)
+        if not constrainable(pspec, shape, self.mesh):
+            pspec = PartitionSpec()
+        return NamedSharding(self.mesh, pspec)
+
+    def input_sharding(self, tensor: Tensor) -> NamedSharding:
+        pspec = self.strategy.input_pspec(tensor.name)
+        if not constrainable(pspec, tensor.shape, self.mesh):
+            pspec = PartitionSpec()
+        return NamedSharding(self.mesh, pspec)
+
+    def label_sharding(self, label_shape) -> NamedSharding:
+        ax = "data" if "data" in self.mesh.shape else list(self.mesh.shape)[0]
+        if label_shape and label_shape[0] % self.mesh.shape[ax] == 0:
+            return NamedSharding(self.mesh, PartitionSpec(ax))
+        return NamedSharding(self.mesh, PartitionSpec())
+
+    # ---------------------------------------------------------------- init
+    def init(self, seed: Optional[int] = None):
+        """Initialize weights sharded-at-birth (no host round trip)."""
+        seed = self.cfg.seed if seed is None else seed
+        layers = topo_order(self.model.layers)
+        overrides = self.model._initializer_overrides
+        shardings = {}
+        for layer in layers:
+            if not layer.weight_specs:
+                continue
+            shardings[layer.name] = {
+                w: self._weight_sharding(layer.name, w, s.shape)
+                for w, s in layer.weight_specs.items()
+            }
+
+        def init_fn(key):
+            params = {}
+            for layer in layers:
+                if not layer.weight_specs:
+                    continue
+                d = {}
+                for i, (wname, spec) in enumerate(sorted(layer.weight_specs.items())):
+                    init = overrides.get((layer.name, wname)) or default_initializer(wname)
+                    k = jax.random.fold_in(jax.random.fold_in(key, layer.guid), i)
+                    d[wname] = init(k, spec)
+                params[layer.name] = d
+            return params
+
+        self.params = jax.jit(init_fn, out_shardings=shardings)(jax.random.PRNGKey(seed))
+        self.state = {}
+        self.opt_state = self.tx.init(self.params)
+        self._iteration = 0
+        return self.params
+
+    # ---------------------------------------------------------------- steps
+    def _build_steps(self):
+        forward_fn = self.forward_fn
+        loss_type, metric_types = self.loss_type, self.metrics
+        tx = self.tx
+        remat = self.cfg.remat
+
+        def train_step(params, opt_state, state, inputs, label, rng):
+            def loss_fn(p):
+                fwd = forward_fn
+                if remat:
+                    fwd = jax.checkpoint(forward_fn, static_argnums=(3,))
+                outs, new_state = fwd(p, state, inputs, True, rng)
+                logits = outs[0]
+                loss = compute_loss(loss_type, logits.astype(jnp.float32), label)
+                return loss, (logits, new_state)
+
+            (loss, (logits, new_state)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            mvals = compute_metrics(metric_types, logits.astype(jnp.float32), label)
+            return params, opt_state, new_state, loss, mvals
+
+        def eval_step(params, state, inputs, label):
+            outs, _ = forward_fn(params, state, inputs, False, jax.random.PRNGKey(0))
+            logits = outs[0].astype(jnp.float32)
+            loss = compute_loss(loss_type, logits, label)
+            return loss, compute_metrics(metric_types, logits, label)
+
+        def infer(params, state, inputs):
+            outs, _ = forward_fn(params, state, inputs, False, jax.random.PRNGKey(0))
+            return outs
+
+        self.train_step = jax.jit(train_step, donate_argnums=(0, 1, 2))
+        self.eval_step = jax.jit(eval_step)
+        self.infer_step = jax.jit(infer)
+
+    # ------------------------------------------------------------- training
+    def fit(self, x, y, batch_size: Optional[int] = None, epochs: Optional[int] = None,
+            callbacks=None, verbose: bool = True):
+        xs = x if isinstance(x, (list, tuple)) else [x]
+        batch_size = batch_size or self.cfg.batch_size
+        epochs = epochs or self.cfg.epochs
+        if self.params is None:
+            self.init()
+        # batch must match the traced graph-input batch dim (static shapes)
+        gb = self.model.input_tensors[0].shape[0]
+        if batch_size != gb:
+            import warnings
+
+            warnings.warn(f"batch_size={batch_size} coerced to graph batch {gb} "
+                          "(XLA static shapes; rebuild the model to change it)")
+            batch_size = gb
+        loader = SingleDataLoader(xs, y, batch_size, shuffle=True, seed=self.cfg.seed)
+        in_sh = [self.input_sharding(t) for t in self.model.input_tensors]
+        lab_sh = self.label_sharding((batch_size,) + tuple(np.asarray(y).shape[1:]))
+        base_rng = jax.random.PRNGKey(self.cfg.seed + 17)
+        history = []
+        for epoch in range(epochs):
+            pm = PerfMetrics()
+            t0 = time.perf_counter()
+            loss_sum, nb = 0.0, 0
+            for dx, dy in prefetch_to_device(loader.epoch(), in_sh, lab_sh):
+                rng = jax.random.fold_in(base_rng, self._iteration)
+                self.params, self.opt_state, self.state, loss, mvals = self.train_step(
+                    self.params, self.opt_state, self.state, dx, dy, rng)
+                self._iteration += 1
+                loss_sum += float(loss)
+                nb += 1
+                pm.update(batch_size, {k: float(v) for k, v in mvals.items()})
+                self._maybe_recompile()
+            dt = time.perf_counter() - t0
+            summ = pm.summary()
+            summ["loss"] = loss_sum / max(1, nb)
+            summ["epoch_time_s"] = dt
+            summ["samples_per_sec"] = pm.train_all / dt if dt > 0 else 0.0
+            history.append(summ)
+            if verbose:
+                ms = " ".join(f"{k}={v:.4f}" for k, v in summ.items() if k != "samples")
+                print(f"[epoch {epoch}] {ms}")
+            for cb in callbacks or []:
+                if hasattr(cb, "on_epoch_end"):
+                    cb.on_epoch_end(epoch, summ)
+        return history
+
+    def evaluate(self, x, y, batch_size: Optional[int] = None):
+        # batch is pinned to the traced graph batch; tail samples beyond the
+        # last full batch are excluded (drop_remainder, like the reference's
+        # shard-sized batches)
+        xs = x if isinstance(x, (list, tuple)) else [x]
+        batch_size = self.model.input_tensors[0].shape[0]
+        loader = SingleDataLoader(xs, y, batch_size, shuffle=False)
+        in_sh = [self.input_sharding(t) for t in self.model.input_tensors]
+        lab_sh = self.label_sharding((batch_size,) + tuple(np.asarray(y).shape[1:]))
+        pm = PerfMetrics()
+        total_loss, nb = 0.0, 0
+        for dx, dy in prefetch_to_device(loader.epoch(), in_sh, lab_sh):
+            loss, mvals = self.eval_step(self.params, self.state, dx, dy)
+            pm.update(batch_size, {k: float(v) for k, v in mvals.items()})
+            total_loss += float(loss)
+            nb += 1
+        out = pm.summary()
+        out["loss"] = total_loss / max(1, nb)
+        return out
+
+    def forward(self, *inputs):
+        if self.params is None:
+            self.init()
+        arrs = [jax.device_put(np.asarray(a), s)
+                for a, s in zip(inputs, [self.input_sharding(t) for t in self.model.input_tensors])]
+        outs = self.infer_step(self.params, self.state, arrs)
+        return outs[0] if len(outs) == 1 else outs
+
+    # ------------------------------------------------- recompile-on-condition
+    def recompile_on_condition(self, trigger_fn, alter_fn):
+        """Reference: RecompileState (include/flexflow/recompile.h:26-43),
+        FFModel::recompile_on_condition (src/runtime/model.cc:2422)."""
+        self.recompile_state = (trigger_fn, alter_fn)
+
+    def _maybe_recompile(self):
+        if self.recompile_state is None:
+            return
+        trigger, alter = self.recompile_state
+        if trigger(self):
+            alter(self)
+            self.forward_fn = build_forward(self.model.layers, self.model.input_tensors,
+                                            self.outputs, self.mesh, self.strategy)
+            self._build_steps()
+
+    # ------------------------------------------------------------- weights
+    def get_weight(self, layer_name: str, wname: str = "kernel") -> np.ndarray:
+        return np.asarray(self.params[layer_name][wname])
+
+    def set_weight(self, layer_name: str, wname: str, value):
+        value = jnp.asarray(value)
+        target = self.params[layer_name][wname]
+        assert value.shape == target.shape, (value.shape, target.shape)
+        self.params[layer_name][wname] = jax.device_put(value, target.sharding)

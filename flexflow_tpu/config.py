@@ -1,0 +1,138 @@
+"""FFConfig — runtime knobs + CLI parsing.
+
+Reference analog: `FFConfig` (include/flexflow/config.h:92-160) and
+`FFConfig::parse_args` (src/runtime/model.cc:3566-3720). Flags keep the
+reference's spellings where they exist (-e, -b, --lr, --budget, ...) plus
+TPU-specific knobs (mesh shape, dtype policy, remat).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import os
+from typing import Dict, List, Optional, Tuple
+
+
+@dataclasses.dataclass
+class FFConfig:
+    # training
+    epochs: int = 1
+    batch_size: int = 64
+    learning_rate: float = 0.01
+    weight_decay: float = 1e-4
+    iterations: int = 0  # 0 = derive from dataset size
+    seed: int = 0
+    # machine: logical mesh. Empty -> 1D mesh over all visible devices ("data",).
+    mesh_shape: Dict[str, int] = dataclasses.field(default_factory=dict)
+    num_nodes: int = 1
+    workers_per_node: int = 0  # 0 = all local devices
+    # search (reference: --budget/--alpha/--only-data-parallel/...)
+    search_budget: int = 0
+    search_alpha: float = 1.05
+    only_data_parallel: bool = False
+    enable_parameter_parallel: bool = True
+    enable_attribute_parallel: bool = True
+    base_optimize_threshold: int = 10
+    search_num_nodes: int = 0  # search for a machine larger than the real one
+    search_num_workers: int = 0
+    import_strategy_file: str = ""
+    export_strategy_file: str = ""
+    memory_search: bool = False
+    substitution_json: str = ""
+    # machine model (cost model) description file; "" = default v5p-like model
+    machine_model_file: str = ""
+    # execution
+    enable_fusion: bool = True
+    profiling: bool = False
+    allow_tensor_op_math_conversion: bool = True  # = bf16 matmul policy
+    compute_dtype: str = "float32"  # params dtype; "bfloat16" enables mixed policy
+    remat: bool = False  # jax.checkpoint the forward for memory
+    donate_state: bool = True
+    # observability
+    export_dot: str = ""  # --compgraph analog
+    include_costs_dot_graph: bool = False
+    log_level: str = "info"
+
+    @property
+    def total_devices(self) -> int:
+        if self.mesh_shape:
+            n = 1
+            for v in self.mesh_shape.values():
+                n *= v
+            return n
+        import jax
+
+        return len(jax.devices())
+
+    @staticmethod
+    def parse_args(argv: Optional[List[str]] = None) -> "FFConfig":
+        p = argparse.ArgumentParser("flexflow_tpu", allow_abbrev=False)
+        p.add_argument("-e", "--epochs", type=int, default=1)
+        p.add_argument("-b", "--batch-size", type=int, default=64)
+        p.add_argument("--lr", "--learning-rate", dest="lr", type=float, default=0.01)
+        p.add_argument("--wd", "--weight-decay", dest="wd", type=float, default=1e-4)
+        p.add_argument("--iterations", type=int, default=0)
+        p.add_argument("--seed", type=int, default=0)
+        p.add_argument("--mesh", type=str, default="", help="e.g. data=4,model=2")
+        p.add_argument("--nodes", type=int, default=1)
+        p.add_argument("-ll:tpu", "--workers-per-node", dest="workers", type=int, default=0)
+        p.add_argument("--budget", "--search-budget", dest="budget", type=int, default=0)
+        p.add_argument("--alpha", "--search-alpha", dest="alpha", type=float, default=1.05)
+        p.add_argument("--only-data-parallel", action="store_true")
+        p.add_argument("--enable-parameter-parallel", action=argparse.BooleanOptionalAction,
+                       default=True)
+        p.add_argument("--enable-attribute-parallel", action=argparse.BooleanOptionalAction,
+                       default=True)
+        p.add_argument("--base-optimize-threshold", type=int, default=10)
+        p.add_argument("--search-num-nodes", type=int, default=0)
+        p.add_argument("--search-num-workers", type=int, default=0)
+        p.add_argument("--import", dest="import_file", type=str, default="")
+        p.add_argument("--export", dest="export_file", type=str, default="")
+        p.add_argument("--memory-search", action="store_true")
+        p.add_argument("--substitution-json", type=str, default="")
+        p.add_argument("--machine-model-file", type=str, default="")
+        p.add_argument("--fusion", dest="fusion", action="store_true", default=True)
+        p.add_argument("--no-fusion", dest="fusion", action="store_false")
+        p.add_argument("--profiling", action="store_true")
+        p.add_argument("--compute-dtype", type=str, default="float32")
+        p.add_argument("--remat", action="store_true")
+        p.add_argument("--compgraph", dest="export_dot", type=str, default="")
+        p.add_argument("--include-costs-dot-graph", action="store_true")
+        args, _unknown = p.parse_known_args(argv)
+
+        mesh: Dict[str, int] = {}
+        if args.mesh:
+            for part in args.mesh.split(","):
+                k, v = part.split("=")
+                mesh[k.strip()] = int(v)
+        return FFConfig(
+            epochs=args.epochs,
+            batch_size=args.batch_size,
+            learning_rate=args.lr,
+            weight_decay=args.wd,
+            iterations=args.iterations,
+            seed=args.seed,
+            mesh_shape=mesh,
+            num_nodes=args.nodes,
+            workers_per_node=args.workers,
+            search_budget=args.budget,
+            search_alpha=args.alpha,
+            only_data_parallel=args.only_data_parallel,
+            enable_parameter_parallel=args.enable_parameter_parallel,
+            enable_attribute_parallel=args.enable_attribute_parallel,
+            base_optimize_threshold=args.base_optimize_threshold,
+            search_num_nodes=args.search_num_nodes,
+            search_num_workers=args.search_num_workers,
+            import_strategy_file=args.import_file,
+            export_strategy_file=args.export_file,
+            memory_search=args.memory_search,
+            substitution_json=args.substitution_json,
+            machine_model_file=args.machine_model_file,
+            enable_fusion=args.fusion,
+            profiling=args.profiling,
+            compute_dtype=args.compute_dtype,
+            remat=args.remat,
+            export_dot=args.export_dot,
+            include_costs_dot_graph=args.include_costs_dot_graph,
+        )

@@ -1,0 +1,5 @@
+from flexflow_tpu.core.tensor import Tensor, TensorSpec
+from flexflow_tpu.core.layer import Layer
+from flexflow_tpu.core.model import FFModel
+
+__all__ = ["Tensor", "TensorSpec", "Layer", "FFModel"]

@@ -1,0 +1,111 @@
+"""Layer-graph utilities: topological order, dot export, simple analyses.
+
+Reference analog: graph algorithms in include/flexflow/{basic_graph.h,
+dominators.h} and dot export in src/utils/dot/. Heavy algorithms (dominators,
+DP-order enumeration) are accelerated by the native C++ core when built
+(flexflow_tpu/native); this module keeps pure-Python versions as both the
+reference implementation and the fallback.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Dict, Iterable, List, Sequence, Set
+
+from flexflow_tpu.core.layer import Layer
+
+
+def topo_order(layers: Sequence[Layer]) -> List[Layer]:
+    """Kahn topological order over layer dependencies (input-tensor owners)."""
+    layers = list(layers)
+    index = {l: i for i, l in enumerate(layers)}
+    indeg = {l: 0 for l in layers}
+    succs: Dict[Layer, List[Layer]] = defaultdict(list)
+    for l in layers:
+        for t in l.inputs:
+            if t.owner is not None and t.owner in index:
+                succs[t.owner].append(l)
+                indeg[l] += 1
+    # stable: seed queue in original order
+    queue = [l for l in layers if indeg[l] == 0]
+    out: List[Layer] = []
+    while queue:
+        l = queue.pop(0)
+        out.append(l)
+        for s in succs[l]:
+            indeg[s] -= 1
+            if indeg[s] == 0:
+                queue.append(s)
+    if len(out) != len(layers):
+        raise ValueError("cycle detected in layer graph")
+    return out
+
+
+def predecessors(layer: Layer, universe: Set[Layer]) -> List[Layer]:
+    return [t.owner for t in layer.inputs if t.owner is not None and t.owner in universe]
+
+
+def dominators(layers: Sequence[Layer]) -> Dict[Layer, Set[Layer]]:
+    """Forward dominator sets (reference: include/flexflow/dominators.h).
+
+    dom(n) = {n} ∪ ⋂ dom(p) over predecessors p. Sources dominate themselves.
+    Used by the search to find sequence-split bottleneck nodes.
+    """
+    order = topo_order(layers)
+    universe = set(order)
+    dom: Dict[Layer, Set[Layer]] = {}
+    for l in order:
+        preds = predecessors(l, universe)
+        if not preds:
+            dom[l] = {l}
+        else:
+            inter = set(dom[preds[0]])
+            for p in preds[1:]:
+                inter &= dom[p]
+            inter.add(l)
+            dom[l] = inter
+    return dom
+
+
+def post_dominators(layers: Sequence[Layer]) -> Dict[Layer, Set[Layer]]:
+    """Post-dominator sets computed over the reversed graph."""
+    order = topo_order(layers)
+    universe = set(order)
+    succs: Dict[Layer, List[Layer]] = defaultdict(list)
+    for l in order:
+        for p in predecessors(l, universe):
+            succs[p].append(l)
+    pdom: Dict[Layer, Set[Layer]] = {}
+    for l in reversed(order):
+        ss = succs[l]
+        if not ss:
+            pdom[l] = {l}
+        else:
+            inter = set(pdom[ss[0]])
+            for s in ss[1:]:
+                inter &= pdom[s]
+            inter.add(l)
+            pdom[l] = inter
+    return pdom
+
+
+def to_dot(layers: Sequence[Layer], annotations: Dict[Layer, str] | None = None) -> str:
+    """Graphviz export (reference: Graph::export_strategy_computation_graph,
+    include/flexflow/graph.h:337-344)."""
+    annotations = annotations or {}
+    lines = ["digraph PCG {", "  rankdir=TB;", '  node [shape=record, fontsize=10];']
+    ids = {l: f"n{l.guid}" for l in layers}
+    for l in layers:
+        extra = annotations.get(l, "")
+        outspecs = "/".join(repr(o.spec) for o in l.outputs)
+        label = f"{l.name}|{outspecs}"
+        if extra:
+            label += f"|{extra}"
+        label = label.replace("[", "(").replace("]", ")")
+        lines.append(f'  {ids[l]} [label="{{{label}}}"];')
+    for l in layers:
+        for t in l.inputs:
+            if t.owner is not None and t.owner in ids:
+                lines.append(f"  {ids[t.owner]} -> {ids[l]};")
+    lines.append("}")
+    return "\n".join(lines)

@@ -1,0 +1,375 @@
+"""FFModel — the model-builder and training entry point.
+
+Reference analog: `FFModel` (include/flexflow/model.h:326, Python mirror
+python/flexflow/core/flexflow_cffi.py:887). The builder methods append Layers
+to the frontend graph; `compile()` is the pivot (reference
+src/runtime/model.cc:2803): it lowers the layer graph to a PCG, runs the
+strategy search (or data-parallel fallback), and builds one jitted SPMD train
+step; `fit()` is the training loop (flexflow_cffi.py:2062).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.core.graph import topo_order, to_dot
+from flexflow_tpu.core.layer import Layer
+from flexflow_tpu.core.tensor import Tensor, TensorSpec
+from flexflow_tpu.dtype import DataType
+from flexflow_tpu.losses import LossType
+from flexflow_tpu.metrics import MetricsType
+from flexflow_tpu.ops import get_op_def
+from flexflow_tpu.ops.op_type import OperatorType
+
+
+class FFModel:
+    def __init__(self, config: Optional[FFConfig] = None):
+        self.config = config or FFConfig()
+        self.layers: List[Layer] = []
+        self.input_tensors: List[Tensor] = []
+        self._dedup: Dict[Tuple, Layer] = {}
+        self.label_tensor: Optional[Tensor] = None
+        self._compiled = None  # CompiledModel after compile()
+        self._initializer_overrides: Dict[Tuple[str, str], Any] = {}
+
+    # ---------------------------------------------------------------- builder
+    def create_tensor(self, dims: Sequence[int], dtype=DataType.FLOAT,
+                      name: Optional[str] = None) -> Tensor:
+        t = Tensor(TensorSpec(tuple(dims), DataType.from_any(dtype)), name=name)
+        self.input_tensors.append(t)
+        return t
+
+    def _add_layer(self, op_type: OperatorType, params: Dict[str, Any],
+                   inputs: Sequence[Tensor], name: Optional[str] = None,
+                   initializers: Optional[Dict[str, Any]] = None) -> List[Tensor]:
+        layer = Layer(op_type, params, list(inputs), name=name)
+        specs = get_op_def(op_type).infer(layer)
+        for i, spec in enumerate(specs):
+            layer.add_output(spec, idx=i)
+        self.layers.append(layer)
+        if initializers:
+            for wname, init in initializers.items():
+                if init is not None:
+                    self._initializer_overrides[(layer.name, wname)] = init
+        return layer.outputs
+
+    # dense / conv family -------------------------------------------------
+    def dense(self, input: Tensor, out_dim: int, activation=None, use_bias: bool = True,
+              kernel_initializer=None, bias_initializer=None, name=None) -> Tensor:
+        return self._add_layer(
+            OperatorType.LINEAR,
+            {"out_dim": int(out_dim), "activation": activation, "use_bias": use_bias},
+            [input], name,
+            {"kernel": kernel_initializer, "bias": bias_initializer},
+        )[0]
+
+    def conv2d(self, input: Tensor, out_channels: int, kernel_h: int, kernel_w: int,
+               stride_h: int = 1, stride_w: int = 1, padding_h: int = 0, padding_w: int = 0,
+               activation=None, groups: int = 1, use_bias: bool = True,
+               kernel_initializer=None, bias_initializer=None, name=None) -> Tensor:
+        return self._add_layer(
+            OperatorType.CONV2D,
+            {"out_channels": int(out_channels), "kernel_h": kernel_h, "kernel_w": kernel_w,
+             "stride_h": stride_h, "stride_w": stride_w, "padding_h": padding_h,
+             "padding_w": padding_w, "activation": activation, "groups": groups,
+             "use_bias": use_bias},
+            [input], name,
+            {"kernel": kernel_initializer, "bias": bias_initializer},
+        )[0]
+
+    def pool2d(self, input: Tensor, kernel_h: int, kernel_w: int, stride_h: int = 1,
+               stride_w: int = 1, padding_h: int = 0, padding_w: int = 0,
+               pool_type: str = "max", activation=None, name=None) -> Tensor:
+        return self._add_layer(
+            OperatorType.POOL2D,
+            {"kernel_h": kernel_h, "kernel_w": kernel_w, "stride_h": stride_h,
+             "stride_w": stride_w, "padding_h": padding_h, "padding_w": padding_w,
+             "pool_type": pool_type, "activation": activation},
+            [input], name)[0]
+
+    def embedding(self, input: Tensor, num_entries: int, out_dim: int, aggr: str = "none",
+                  dtype=DataType.FLOAT, kernel_initializer=None, name=None) -> Tensor:
+        return self._add_layer(
+            OperatorType.EMBEDDING,
+            {"num_entries": int(num_entries), "out_dim": int(out_dim), "aggr": aggr,
+             "dtype": DataType.from_any(dtype).value},
+            [input], name, {"kernel": kernel_initializer})[0]
+
+    def batch_matmul(self, A: Tensor, B: Tensor, a_seq_length_dim: int = -1,
+                     b_seq_length_dim: int = -1, name=None) -> Tensor:
+        return self._add_layer(
+            OperatorType.BATCHMATMUL,
+            {"a_seq_length_dim": a_seq_length_dim, "b_seq_length_dim": b_seq_length_dim},
+            [A, B], name)[0]
+
+    def multihead_attention(self, query: Tensor, key: Tensor, value: Tensor,
+                            embed_dim: int, num_heads: int, kdim: int = 0, vdim: int = 0,
+                            dropout: float = 0.0, bias: bool = True, add_bias_kv: bool = False,
+                            add_zero_attn: bool = False, causal: bool = False,
+                            kernel_initializer=None, impl: str = "auto", name=None) -> Tensor:
+        return self._add_layer(
+            OperatorType.MULTIHEAD_ATTENTION,
+            {"embed_dim": int(embed_dim), "num_heads": int(num_heads), "kdim": kdim,
+             "vdim": vdim, "dropout": dropout, "bias": bias, "add_bias_kv": add_bias_kv,
+             "add_zero_attn": add_zero_attn, "causal": causal, "impl": impl},
+            [query, key, value], name,
+            {"wq": kernel_initializer, "wk": kernel_initializer, "wv": kernel_initializer,
+             "wo": kernel_initializer})[0]
+
+    # elementwise ---------------------------------------------------------
+    def _unary(self, op, input, name=None, **params) -> Tensor:
+        return self._add_layer(op, params, [input], name)[0]
+
+    def _binary(self, op, a, b, name=None) -> Tensor:
+        return self._add_layer(op, {}, [a, b], name)[0]
+
+    def add(self, a, b, name=None):
+        return self._binary(OperatorType.EW_ADD, a, b, name)
+
+    def subtract(self, a, b, name=None):
+        return self._binary(OperatorType.EW_SUB, a, b, name)
+
+    def multiply(self, a, b, name=None):
+        return self._binary(OperatorType.EW_MUL, a, b, name)
+
+    def divide(self, a, b, name=None):
+        return self._binary(OperatorType.EW_DIV, a, b, name)
+
+    def max(self, a, b, name=None):
+        return self._binary(OperatorType.EW_MAX, a, b, name)
+
+    def min(self, a, b, name=None):
+        return self._binary(OperatorType.EW_MIN, a, b, name)
+
+    def relu(self, input, name=None):
+        return self._unary(OperatorType.RELU, input, name)
+
+    def identity(self, input, name=None):
+        return self._unary(OperatorType.IDENTITY, input, name)
+
+    def sigmoid(self, input, name=None):
+        return self._unary(OperatorType.SIGMOID, input, name)
+
+    def tanh(self, input, name=None):
+        return self._unary(OperatorType.TANH, input, name)
+
+    def elu(self, input, name=None):
+        return self._unary(OperatorType.ELU, input, name)
+
+    def gelu(self, input, name=None):
+        return self._unary(OperatorType.GELU, input, name)
+
+    def silu(self, input, name=None):
+        return self._unary(OperatorType.SILU, input, name)
+
+    def exp(self, input, name=None):
+        return self._unary(OperatorType.EXP, input, name)
+
+    def log(self, input, name=None):
+        return self._unary(OperatorType.LOG, input, name)
+
+    def sin(self, input, name=None):
+        return self._unary(OperatorType.SIN, input, name)
+
+    def cos(self, input, name=None):
+        return self._unary(OperatorType.COS, input, name)
+
+    def sqrt(self, input, name=None):
+        return self._unary(OperatorType.SQRT, input, name)
+
+    def rsqrt(self, input, name=None):
+        return self._unary(OperatorType.RSQRT, input, name)
+
+    def pow(self, input, exponent: float, name=None):
+        return self._unary(OperatorType.POW, input, name, exponent=exponent)
+
+    def scalar_multiply(self, input, scalar: float, name=None):
+        return self._unary(OperatorType.SCALAR_MULTIPLY, input, name, scalar=scalar)
+
+    def scalar_add(self, input, scalar: float, name=None):
+        return self._unary(OperatorType.SCALAR_ADD, input, name, scalar=scalar)
+
+    def scalar_sub(self, input, scalar: float, name=None):
+        return self._unary(OperatorType.SCALAR_SUB, input, name, scalar=scalar)
+
+    def scalar_true_divide(self, input, scalar: float, name=None):
+        return self._unary(OperatorType.SCALAR_TRUE_DIV, input, name, scalar=scalar)
+
+    # norm / softmax / dropout -------------------------------------------
+    def batch_norm(self, input, relu: bool = True, momentum: float = 0.9,
+                   eps: float = 1e-5, name=None):
+        return self._add_layer(OperatorType.BATCHNORM,
+                               {"relu": relu, "momentum": momentum, "eps": eps},
+                               [input], name)[0]
+
+    def layer_norm(self, input, axes=None, elementwise_affine: bool = True,
+                   eps: float = 1e-5, name=None):
+        return self._add_layer(OperatorType.LAYERNORM,
+                               {"axes": axes, "elementwise_affine": elementwise_affine,
+                                "eps": eps},
+                               [input], name)[0]
+
+    def softmax(self, input, axis: int = -1, name=None):
+        return self._add_layer(OperatorType.SOFTMAX, {"axis": axis}, [input], name)[0]
+
+    def log_softmax(self, input, axis: int = -1, name=None):
+        return self._add_layer(OperatorType.LOG_SOFTMAX, {"axis": axis}, [input], name)[0]
+
+    def dropout(self, input, rate: float = 0.5, seed: int = 0, name=None):
+        return self._add_layer(OperatorType.DROPOUT, {"rate": rate, "seed": seed},
+                               [input], name)[0]
+
+    # shape ops -----------------------------------------------------------
+    def reshape(self, input, shape: Sequence[int], name=None):
+        return self._add_layer(OperatorType.RESHAPE, {"shape": tuple(shape)}, [input], name)[0]
+
+    def transpose(self, input, perm: Sequence[int], name=None):
+        return self._add_layer(OperatorType.TRANSPOSE, {"perm": tuple(perm)}, [input], name)[0]
+
+    def flat(self, input, name=None):
+        return self._add_layer(OperatorType.FLAT, {}, [input], name)[0]
+
+    def concat(self, tensors: Sequence[Tensor], axis: int, name=None):
+        return self._add_layer(OperatorType.CONCAT, {"axis": axis}, list(tensors), name)[0]
+
+    def split(self, input, sizes: Union[int, Sequence[int]], axis: int, name=None) -> List[Tensor]:
+        if isinstance(sizes, int):
+            d = input.shape[axis % input.ndim]
+            assert d % sizes == 0
+            sizes = [d // sizes] * sizes
+        return self._add_layer(OperatorType.SPLIT, {"sizes": tuple(sizes), "axis": axis},
+                               [input], name)
+
+    def reverse(self, input, axis: int, name=None):
+        return self._add_layer(OperatorType.REVERSE, {"axis": axis}, [input], name)[0]
+
+    def pad(self, input, pads, value=0.0, name=None):
+        return self._add_layer(OperatorType.PAD, {"pads": tuple(map(tuple, pads)), "value": value},
+                               [input], name)[0]
+
+    def cast(self, input, dtype, name=None):
+        return self._add_layer(OperatorType.CAST,
+                               {"dtype": DataType.from_any(dtype).value}, [input], name)[0]
+
+    def gather(self, input, index: Tensor, dim: int, name=None):
+        return self._add_layer(OperatorType.GATHER, {"dim": dim}, [input, index], name)[0]
+
+    def slice_tensor(self, input, starts, limits, name=None):
+        return self._add_layer(OperatorType.SLICE,
+                               {"starts": tuple(starts), "limits": tuple(limits)},
+                               [input], name)[0]
+
+    # reductions ----------------------------------------------------------
+    def reduce_sum(self, input, axes, keepdims: bool = False, name=None):
+        return self._add_layer(OperatorType.REDUCE_SUM,
+                               {"axes": tuple(axes), "keepdims": keepdims}, [input], name)[0]
+
+    def reduce_mean(self, input, axes, keepdims: bool = False, name=None):
+        return self._add_layer(OperatorType.REDUCE_MEAN,
+                               {"axes": tuple(axes), "keepdims": keepdims}, [input], name)[0]
+
+    def mean(self, input, axes, keepdims: bool = False, name=None):
+        return self._add_layer(OperatorType.MEAN,
+                               {"axes": tuple(axes), "keepdims": keepdims}, [input], name)[0]
+
+    def argmax(self, input, axis: int = -1, name=None):
+        return self._add_layer(OperatorType.ARGMAX, {"axis": axis}, [input], name)[0]
+
+    def top_k(self, input, k: int, sorted: bool = True, name=None) -> List[Tensor]:
+        return self._add_layer(OperatorType.TOPK, {"k": int(k), "sorted": sorted}, [input], name)
+
+    # MoE -----------------------------------------------------------------
+    def group_by(self, data: Tensor, assign: Tensor, n_experts: int, alpha: float = 1.0,
+                 name=None) -> List[Tensor]:
+        return self._add_layer(OperatorType.GROUP_BY,
+                               {"n_experts": int(n_experts), "alpha": alpha},
+                               [data, assign], name)
+
+    def experts(self, dispatched: Tensor, out_dim: int, activation=None,
+                use_bias: bool = True, name=None) -> Tensor:
+        return self._add_layer(OperatorType.EXPERTS,
+                               {"out_dim": int(out_dim), "activation": activation,
+                                "use_bias": use_bias},
+                               [dispatched], name)[0]
+
+    def aggregate(self, gates: Tensor, assign: Tensor, positions: Tensor,
+                  expert_outputs: Tensor, name=None) -> Tensor:
+        return self._add_layer(OperatorType.AGGREGATE, {},
+                               [gates, assign, positions, expert_outputs], name)[0]
+
+    def aggregate_spec(self, gates, assign, positions, expert_outputs, name=None) -> Tensor:
+        return self._add_layer(OperatorType.AGGREGATE_SPEC, {},
+                               [gates, assign, positions, expert_outputs], name)[0]
+
+    def cache(self, input: Tensor, num_batches: int = 1, name=None) -> Tensor:
+        return self._add_layer(OperatorType.CACHE, {"num_batches": num_batches}, [input], name)[0]
+
+    def moe(self, input: Tensor, num_exp: int, num_select: int, expert_hidden_size: int,
+            alpha: float = 2.0, lambda_bal: float = 0.0, name=None) -> Tensor:
+        """Composite MoE block (reference: FFModel::moe include/flexflow/model.h:509-514,
+        src/ops/moe.cc): topk gating + group_by + per-expert dense + aggregate."""
+        gate_logits = self.dense(input, num_exp, name=f"{name or 'moe'}_gate")
+        gate_probs = self.softmax(gate_logits)
+        topk_vals, topk_idx = self.top_k(gate_probs, num_select)
+        dispatched, positions = self.group_by(input, topk_idx, num_exp, alpha)
+        hidden = self.experts(dispatched, expert_hidden_size, activation="relu",
+                              name=f"{name or 'moe'}_experts")
+        return self.aggregate(topk_vals, topk_idx, positions, hidden, name=f"{name or 'moe'}_agg")
+
+    # ------------------------------------------------------------- compile
+    def compile(self, optimizer=None, loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+                metrics: Sequence = (MetricsType.ACCURACY,), comp_mode=None,
+                outputs: Optional[Sequence[Tensor]] = None):
+        from flexflow_tpu.compiler.compile import compile_model
+
+        self._compiled = compile_model(self, optimizer, LossType.from_any(loss_type),
+                                       [MetricsType.from_any(m) for m in metrics],
+                                       outputs=outputs)
+        if self.config.export_dot:
+            with open(self.config.export_dot, "w") as f:
+                f.write(self.dot())
+        return self._compiled
+
+    @property
+    def compiled(self):
+        if self._compiled is None:
+            raise RuntimeError("call compile() first")
+        return self._compiled
+
+    # ------------------------------------------------------------ training
+    def fit(self, x, y, batch_size: Optional[int] = None, epochs: Optional[int] = None,
+            callbacks=None, verbose: bool = True):
+        return self.compiled.fit(x, y, batch_size=batch_size, epochs=epochs,
+                                 callbacks=callbacks, verbose=verbose)
+
+    def forward(self, *inputs):
+        return self.compiled.forward(*inputs)
+
+    def eval(self, x, y, batch_size: Optional[int] = None):
+        return self.compiled.evaluate(x, y, batch_size=batch_size)
+
+    # --------------------------------------------------------------- misc
+    def get_layers(self) -> List[Layer]:
+        return list(self.layers)
+
+    def get_layer_by_name(self, name: str) -> Layer:
+        for l in self.layers:
+            if l.name == name:
+                return l
+        raise KeyError(name)
+
+    def get_parameter_by_name(self, layer_name: str, wname: str = "kernel"):
+        return self.compiled.get_weight(layer_name, wname)
+
+    def set_parameter_by_name(self, layer_name: str, wname: str, value: np.ndarray):
+        self.compiled.set_weight(layer_name, wname, value)
+
+    def dot(self) -> str:
+        ann = {}
+        if self._compiled is not None:
+            ann = {l: str(self._compiled.strategy.op_shardings.get(l.name, ""))
+                   for l in self.layers}
+        return to_dot(topo_order(self.layers), ann)

@@ -1,0 +1,61 @@
+"""Data types (reference: include/flexflow/ffconst.h DataType enum)."""
+
+from __future__ import annotations
+
+import enum
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class DataType(enum.Enum):
+    BOOL = "bool"
+    INT32 = "int32"
+    INT64 = "int64"
+    HALF = "float16"
+    BF16 = "bfloat16"
+    FLOAT = "float32"
+    DOUBLE = "float64"
+
+    @property
+    def jnp_dtype(self):
+        return _JNP[self]
+
+    @property
+    def np_dtype(self):
+        return _NP[self]
+
+    @property
+    def itemsize(self) -> int:
+        return np.dtype(self.np_dtype).itemsize if self is not DataType.BF16 else 2
+
+    @staticmethod
+    def from_any(x) -> "DataType":
+        if isinstance(x, DataType):
+            return x
+        s = str(jnp.dtype(x)) if not isinstance(x, str) else x
+        for dt in DataType:
+            if dt.value == s:
+                return dt
+        raise ValueError(f"unknown dtype {x!r}")
+
+
+_JNP = {
+    DataType.BOOL: jnp.bool_,
+    DataType.INT32: jnp.int32,
+    DataType.INT64: jnp.int64,
+    DataType.HALF: jnp.float16,
+    DataType.BF16: jnp.bfloat16,
+    DataType.FLOAT: jnp.float32,
+    DataType.DOUBLE: jnp.float64,
+}
+
+_NP = {
+    DataType.BOOL: np.bool_,
+    DataType.INT32: np.int32,
+    DataType.INT64: np.int64,
+    DataType.HALF: np.float16,
+    DataType.BF16: jnp.bfloat16,  # numpy via ml_dtypes through jnp
+    DataType.FLOAT: np.float32,
+    DataType.DOUBLE: np.float64,
+}

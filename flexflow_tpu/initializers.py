@@ -1,0 +1,87 @@
+"""Weight initializers.
+
+Reference analog: include/flexflow/initializer.h:26-110 (Glorot/Zero/Uniform/
+Norm/Constant, executed as Legion index tasks over the weight regions). Here an
+initializer is a pure function (key, spec) -> array; the compiled model
+initializes every weight directly into its target sharding via jax.jit
+out_shardings, so large models materialize sharded (no host round-trip).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from flexflow_tpu.core.tensor import TensorSpec
+
+
+class Initializer:
+    def __call__(self, key: jax.Array, spec: TensorSpec) -> jax.Array:
+        raise NotImplementedError
+
+
+class GlorotUniformInitializer(Initializer):
+    def __init__(self, seed: int = 0):
+        self.seed = seed
+
+    def __call__(self, key, spec):
+        shape = spec.shape
+        if len(shape) >= 2:
+            # conv kernels (O, I, kh, kw): receptive field multiplies fan terms
+            receptive = math.prod(shape[2:]) if len(shape) > 2 else 1
+            fan_in, fan_out = shape[1] * receptive, shape[0] * receptive
+            if len(shape) == 2:  # dense kernels are (in, out)
+                fan_in, fan_out = shape[0], shape[1]
+        else:
+            fan_in = fan_out = shape[0]
+        limit = math.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(key, shape, spec.dtype.jnp_dtype, -limit, limit)
+
+
+class ZeroInitializer(Initializer):
+    def __call__(self, key, spec):
+        return jnp.zeros(spec.shape, spec.dtype.jnp_dtype)
+
+
+class OneInitializer(Initializer):
+    def __call__(self, key, spec):
+        return jnp.ones(spec.shape, spec.dtype.jnp_dtype)
+
+
+class ConstantInitializer(Initializer):
+    def __init__(self, value: float):
+        self.value = value
+
+    def __call__(self, key, spec):
+        return jnp.full(spec.shape, self.value, spec.dtype.jnp_dtype)
+
+
+class UniformInitializer(Initializer):
+    def __init__(self, seed: int = 0, min_value: float = -0.05, max_value: float = 0.05):
+        self.min_value = min_value
+        self.max_value = max_value
+
+    def __call__(self, key, spec):
+        return jax.random.uniform(key, spec.shape, spec.dtype.jnp_dtype, self.min_value, self.max_value)
+
+
+class NormInitializer(Initializer):
+    def __init__(self, seed: int = 0, mean: float = 0.0, stddev: float = 1.0):
+        self.mean = mean
+        self.stddev = stddev
+
+    def __call__(self, key, spec):
+        return self.mean + self.stddev * jax.random.normal(key, spec.shape, spec.dtype.jnp_dtype)
+
+
+def default_initializer(wname: str) -> Initializer:
+    """Reference default: Glorot for kernels, zero for biases
+    (src/runtime/model.cc dense/conv defaults)."""
+    if wname in ("bias", "beta", "bq", "bk", "bv", "bo") or wname.startswith("bias"):
+        return ZeroInitializer()
+    if wname == "gamma":
+        return OneInitializer()
+    return GlorotUniformInitializer()

@@ -1,0 +1,57 @@
+"""Loss functions.
+
+Reference analog: include/flexflow/loss_functions.h:27-79 and
+src/loss_functions/ — a backward-only Legion task seeding output gradients.
+On TPU the loss is a scalar jnp expression inside the train step and jax.grad
+derives the seeding, so only the forward definition is needed. Scale factors
+match the reference (1/batch, and sparse-CE's intra-batch replica scaling is
+subsumed by global mean).
+"""
+
+from __future__ import annotations
+
+import enum
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+class LossType(enum.Enum):
+    CATEGORICAL_CROSSENTROPY = "categorical_crossentropy"
+    SPARSE_CATEGORICAL_CROSSENTROPY = "sparse_categorical_crossentropy"
+    MEAN_SQUARED_ERROR = "mean_squared_error"
+    MEAN_SQUARED_ERROR_AVG_REDUCE = "mean_squared_error_avg_reduce"
+    IDENTITY = "identity"
+
+    @staticmethod
+    def from_any(x) -> "LossType":
+        if isinstance(x, LossType):
+            return x
+        return LossType(str(x))
+
+
+def compute_loss(loss_type: LossType, logits: jax.Array, labels: jax.Array,
+                 from_logits: bool = True) -> jax.Array:
+    """logits: model output; labels: int ids (sparse) or dense targets."""
+    lt = LossType.from_any(loss_type)
+    if lt is LossType.SPARSE_CATEGORICAL_CROSSENTROPY:
+        labels = labels.reshape(logits.shape[:-1]).astype(jnp.int32)
+        if from_logits:
+            ce = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+        else:
+            logp = jnp.log(jnp.clip(logits, 1e-12))
+            ce = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+        return jnp.mean(ce)
+    if lt is LossType.CATEGORICAL_CROSSENTROPY:
+        if from_logits:
+            ce = optax.softmax_cross_entropy(logits, labels.astype(logits.dtype))
+        else:
+            logp = jnp.log(jnp.clip(logits, 1e-12))
+            ce = -jnp.sum(labels * logp, axis=-1)
+        return jnp.mean(ce)
+    if lt in (LossType.MEAN_SQUARED_ERROR, LossType.MEAN_SQUARED_ERROR_AVG_REDUCE):
+        return jnp.mean(jnp.square(logits - labels.astype(logits.dtype)))
+    if lt is LossType.IDENTITY:
+        return jnp.mean(logits)
+    raise ValueError(lt)

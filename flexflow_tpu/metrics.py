@@ -1,0 +1,90 @@
+"""Training metrics.
+
+Reference analog: include/flexflow/metrics_functions.h:44-79 and
+src/metrics_functions/ — per-shard CUDA metric kernels reduced through a
+future chain into PerfMetrics. Here metrics are jnp expressions computed
+inside the jitted step; PerfMetrics mirrors the reference struct and is
+accumulated on host.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Dict, List, Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+class MetricsType(enum.Enum):
+    ACCURACY = "accuracy"
+    CATEGORICAL_CROSSENTROPY = "categorical_crossentropy"
+    SPARSE_CATEGORICAL_CROSSENTROPY = "sparse_categorical_crossentropy"
+    MEAN_SQUARED_ERROR = "mean_squared_error"
+    ROOT_MEAN_SQUARED_ERROR = "root_mean_squared_error"
+    MEAN_ABSOLUTE_ERROR = "mean_absolute_error"
+
+    @staticmethod
+    def from_any(x) -> "MetricsType":
+        if isinstance(x, MetricsType):
+            return x
+        return MetricsType(str(x))
+
+
+@dataclasses.dataclass
+class PerfMetrics:
+    """Accumulated training metrics (reference: include/flexflow/perf_metrics.h)."""
+
+    train_all: int = 0
+    sums: Dict[str, float] = dataclasses.field(default_factory=dict)
+
+    def update(self, batch: int, values: Dict[str, float]):
+        self.train_all += batch
+        for k, v in values.items():
+            self.sums[k] = self.sums.get(k, 0.0) + v * batch
+
+    @property
+    def train_correct(self) -> int:
+        return int(self.sums.get("accuracy", 0.0))
+
+    def summary(self) -> Dict[str, float]:
+        n = max(1, self.train_all)
+        out = {"samples": float(self.train_all)}
+        for k, v in self.sums.items():
+            out[k] = v / n
+        return out
+
+
+def compute_metrics(metric_types: Sequence[MetricsType], logits: jax.Array,
+                    labels: jax.Array) -> Dict[str, jax.Array]:
+    out: Dict[str, jax.Array] = {}
+    for mt in metric_types:
+        mt = MetricsType.from_any(mt)
+        if mt is MetricsType.ACCURACY:
+            if labels.ndim == logits.ndim and labels.shape == logits.shape:
+                pred = jnp.argmax(logits, -1)
+                true = jnp.argmax(labels, -1)
+            else:
+                pred = jnp.argmax(logits, -1)
+                true = labels.reshape(pred.shape).astype(pred.dtype)
+            out["accuracy"] = jnp.mean((pred == true).astype(jnp.float32))
+        elif mt is MetricsType.CATEGORICAL_CROSSENTROPY:
+            import optax
+
+            out["categorical_crossentropy"] = jnp.mean(
+                optax.softmax_cross_entropy(logits, labels.astype(logits.dtype)))
+        elif mt is MetricsType.SPARSE_CATEGORICAL_CROSSENTROPY:
+            import optax
+
+            l = labels.reshape(logits.shape[:-1]).astype(jnp.int32)
+            out["sparse_categorical_crossentropy"] = jnp.mean(
+                optax.softmax_cross_entropy_with_integer_labels(logits, l))
+        elif mt is MetricsType.MEAN_SQUARED_ERROR:
+            out["mean_squared_error"] = jnp.mean(jnp.square(logits - labels.astype(logits.dtype)))
+        elif mt is MetricsType.ROOT_MEAN_SQUARED_ERROR:
+            out["root_mean_squared_error"] = jnp.sqrt(
+                jnp.mean(jnp.square(logits - labels.astype(logits.dtype))))
+        elif mt is MetricsType.MEAN_ABSOLUTE_ERROR:
+            out["mean_absolute_error"] = jnp.mean(jnp.abs(logits - labels.astype(logits.dtype)))
+    return out

@@ -1,0 +1,30 @@
+"""Fused-activation helper shared by linear/conv/pool lowering paths.
+
+Reference analog: the ActiMode argument on dense/conv ops
+(include/flexflow/ffconst.h AC_MODE_*), executed fused in the cuDNN/cuBLAS
+epilogue; here XLA fuses the jnp call into the matmul/conv automatically.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+_ACTS = {
+    None: lambda x: x,
+    "none": lambda x: x,
+    "relu": jax.nn.relu,
+    "sigmoid": jax.nn.sigmoid,
+    "tanh": jnp.tanh,
+    "gelu": jax.nn.gelu,
+    "elu": jax.nn.elu,
+    "silu": jax.nn.silu,
+    "softmax": jax.nn.softmax,
+}
+
+
+def apply_activation(name, x):
+    if callable(name):
+        return name(x)
+    return _ACTS[name](x)

@@ -1,0 +1,140 @@
+"""Multi-head attention.
+
+Reference analog: src/ops/attention.cc (926) + attention.cu (372), which wrap
+cuDNN MultiHeadAttn (cudnnMultiHeadAttnForward, src/ops/attention.cu:35). The
+TPU lowering is einsum-based scaled-dot-product attention that XLA maps onto
+the MXU; a fused pallas flash-attention kernel
+(flexflow_tpu/kernels/flash_attention.py) is used instead when shapes qualify
+(seq multiple of block size) and `impl` is not forced to "xla".
+
+Head-parallel tensor parallelism (reference substitutions
+create_partition_attention_combine, src/runtime/substitution.cc:1763-1770) is
+expressed by sharding the per-head projection weights on a model axis.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from typing import TYPE_CHECKING
+if TYPE_CHECKING:
+    from flexflow_tpu.core.layer import Layer
+from flexflow_tpu.core.tensor import TensorSpec
+from flexflow_tpu.ops.op_type import OperatorType
+from flexflow_tpu.ops.registry import register_op, LoweringCtx
+
+
+def _mha_infer(layer: Layer):
+    q, k, v = [t.spec for t in layer.inputs[:3]]
+    p = layer.params
+    embed = p["embed_dim"]
+    heads = p["num_heads"]
+    if embed % heads:
+        raise ValueError("num_heads must divide embed_dim")
+    # kdim/vdim are the key/value input feature dims (torch/reference
+    # semantics); they must match the actual inputs if given.
+    if p.get("kdim") and p["kdim"] != k.shape[-1]:
+        raise ValueError(f"kdim={p['kdim']} != key feature dim {k.shape[-1]}")
+    if p.get("vdim") and p["vdim"] != v.shape[-1]:
+        raise ValueError(f"vdim={p['vdim']} != value feature dim {v.shape[-1]}")
+    layer.weight_specs = {
+        "wq": TensorSpec((q.shape[-1], embed), q.dtype),
+        "wk": TensorSpec((k.shape[-1], embed), q.dtype),
+        "wv": TensorSpec((v.shape[-1], embed), q.dtype),
+        "wo": TensorSpec((embed, embed), q.dtype),
+    }
+    if p.get("bias", True):
+        layer.weight_specs.update(
+            {
+                "bq": TensorSpec((embed,), q.dtype),
+                "bk": TensorSpec((embed,), q.dtype),
+                "bv": TensorSpec((embed,), q.dtype),
+                "bo": TensorSpec((embed,), q.dtype),
+            }
+        )
+    if p.get("add_bias_kv", False):
+        layer.weight_specs["bias_k"] = TensorSpec((embed,), q.dtype)
+        layer.weight_specs["bias_v"] = TensorSpec((embed,), q.dtype)
+    return [q.with_shape(q.shape[:-1] + (embed,))]
+
+
+def _split_heads(x, heads):
+    b, s, e = x.shape
+    return x.reshape(b, s, heads, e // heads)
+
+
+def _mha_lower(layer: Layer, inputs, weights, ctx: LoweringCtx):
+    q, k, v = inputs[:3]
+    p = layer.params
+    heads = p["num_heads"]
+    embed = p["embed_dim"]
+    dt = q.dtype
+
+    def proj(x, w, b):
+        y = x @ weights[w].astype(dt)
+        if b in weights:
+            y = y + weights[b].astype(dt)
+        return y
+
+    kp = proj(k, "wk", "bk")
+    vp = proj(v, "wv", "bv")
+    if "bias_k" in weights:  # add_bias_kv: learned extra kv position
+        b_ = k.shape[0]
+        kp = jnp.concatenate([kp, jnp.broadcast_to(weights["bias_k"].astype(dt), (b_, 1, embed))], axis=1)
+        vp = jnp.concatenate([vp, jnp.broadcast_to(weights["bias_v"].astype(dt), (b_, 1, embed))], axis=1)
+    if p.get("add_zero_attn", False):
+        b_ = k.shape[0]
+        kp = jnp.concatenate([kp, jnp.zeros((b_, 1, embed), dt)], axis=1)
+        vp = jnp.concatenate([vp, jnp.zeros((b_, 1, embed), dt)], axis=1)
+    qh = _split_heads(proj(q, "wq", "bq"), heads)  # (b, sq, h, d)
+    kh = _split_heads(kp, heads)
+    vh = _split_heads(vp, heads)
+
+    impl = p.get("impl", "auto")
+    causal = p.get("causal", False)
+    scale = 1.0 / math.sqrt(embed // heads)
+    out = None
+    if impl in ("auto", "flash"):
+        try:
+            from flexflow_tpu.kernels.flash_attention import flash_attention_qkv
+
+            out = flash_attention_qkv(qh, kh, vh, causal=causal, scale=scale, force=(impl == "flash"))
+        except Exception:
+            if impl == "flash":
+                raise
+            out = None
+    if out is None:
+        logits = jnp.einsum("bqhd,bkhd->bhqk", qh, kh) * scale
+        if causal:
+            sq, sk = logits.shape[-2], logits.shape[-1]
+            mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+            logits = jnp.where(mask, logits, jnp.finfo(logits.dtype).min)
+        probs = jax.nn.softmax(logits, axis=-1)
+        if ctx.training and p.get("dropout", 0.0) > 0.0:
+            import jax.random as jrandom
+
+            keep = 1.0 - p["dropout"]
+            mask = jrandom.bernoulli(ctx.rng_for(layer), keep, probs.shape)
+            probs = jnp.where(mask, probs / keep, 0.0).astype(probs.dtype)
+        out = jnp.einsum("bhqk,bkhd->bqhd", probs, vh)
+    b, sq = q.shape[0], q.shape[1]
+    out = out.reshape(b, sq, embed)
+    y = out @ weights["wo"].astype(dt)
+    if "bo" in weights:
+        y = y + weights["bo"].astype(dt)
+    return [y]
+
+
+def _mha_flops(layer: Layer):
+    q, k = layer.inputs[0].spec, layer.inputs[1].spec
+    b, sq, e = q.shape
+    sk = k.shape[1]
+    proj = 2.0 * b * (3 * sq + sq) * e * e  # q,k,v,o projections (approx sq≈sk)
+    attn = 2.0 * b * sq * sk * e * 2  # qk^T and att@v
+    return proj + attn
+
+
+register_op(OperatorType.MULTIHEAD_ATTENTION, _mha_infer, _mha_lower, _mha_flops)

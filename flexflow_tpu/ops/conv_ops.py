@@ -1,0 +1,152 @@
+"""Conv2D, Pool2D, Flat, BatchNorm — NCHW, matching the reference API.
+
+Reference analog: src/ops/conv_2d.cc (1198 LoC, cuDNN), pool_2d.cc (688),
+flat.cc (412), batch_norm.cc (322). Shapes follow the reference (NCHW,
+OIHW kernels); XLA relayouts internally for the TPU MXU/VPU, so the API keeps
+reference semantics without a layout cost at runtime.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from typing import TYPE_CHECKING
+if TYPE_CHECKING:
+    from flexflow_tpu.core.layer import Layer
+from flexflow_tpu.core.tensor import TensorSpec
+from flexflow_tpu.ops.op_type import OperatorType
+from flexflow_tpu.ops.registry import register_op, LoweringCtx
+from flexflow_tpu.ops.activations import apply_activation
+
+
+def _out_hw(h, w, p):
+    kh, kw = p["kernel_h"], p["kernel_w"]
+    sh, sw = p["stride_h"], p["stride_w"]
+    ph, pw = p["padding_h"], p["padding_w"]
+    oh = (h + 2 * ph - kh) // sh + 1
+    ow = (w + 2 * pw - kw) // sw + 1
+    if oh <= 0 or ow <= 0:
+        raise ValueError(f"conv/pool output collapsed: {(oh, ow)}")
+    return oh, ow
+
+
+def _conv2d_infer(layer: Layer):
+    x = layer.inputs[0].spec  # (N, C, H, W)
+    p = layer.params
+    n, c, h, w = x.shape
+    groups = p.get("groups", 1)
+    assert c % groups == 0
+    oc = p["out_channels"]
+    oh, ow = _out_hw(h, w, p)
+    layer.weight_specs = {"kernel": TensorSpec((oc, c // groups, p["kernel_h"], p["kernel_w"]), x.dtype)}
+    if p.get("use_bias", True):
+        layer.weight_specs["bias"] = TensorSpec((oc,), x.dtype)
+    return [x.with_shape((n, oc, oh, ow))]
+
+
+def _conv2d_lower(layer: Layer, inputs, weights, ctx: LoweringCtx):
+    x = inputs[0]
+    p = layer.params
+    y = lax.conv_general_dilated(
+        x,
+        weights["kernel"].astype(x.dtype),
+        window_strides=(p["stride_h"], p["stride_w"]),
+        padding=[(p["padding_h"], p["padding_h"]), (p["padding_w"], p["padding_w"])],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        feature_group_count=p.get("groups", 1),
+    )
+    if "bias" in weights:
+        y = y + weights["bias"].astype(y.dtype)[None, :, None, None]
+    return [apply_activation(p.get("activation"), y)]
+
+
+def _conv2d_flops(layer: Layer):
+    o = layer.outputs[0].spec  # N, OC, OH, OW
+    p = layer.params
+    cin_per_group = layer.inputs[0].spec.shape[1] // p.get("groups", 1)
+    return 2.0 * o.num_elements * cin_per_group * p["kernel_h"] * p["kernel_w"]
+
+
+register_op(OperatorType.CONV2D, _conv2d_infer, _conv2d_lower, _conv2d_flops)
+
+
+def _pool2d_infer(layer: Layer):
+    x = layer.inputs[0].spec
+    n, c, h, w = x.shape
+    oh, ow = _out_hw(h, w, layer.params)
+    return [x.with_shape((n, c, oh, ow))]
+
+
+def _pool2d_lower(layer: Layer, inputs, weights, ctx):
+    x = inputs[0]
+    p = layer.params
+    window = (1, 1, p["kernel_h"], p["kernel_w"])
+    strides = (1, 1, p["stride_h"], p["stride_w"])
+    pads = ((0, 0), (0, 0), (p["padding_h"], p["padding_h"]), (p["padding_w"], p["padding_w"]))
+    if p.get("pool_type", "max") == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+        y = lax.reduce_window(x, init, lax.max, window, strides, pads)
+    else:
+        s = lax.reduce_window(x, 0.0, lax.add, window, strides, pads)
+        # count_include_pad=True matches the reference's cuDNN default
+        y = s / (p["kernel_h"] * p["kernel_w"])
+    return [apply_activation(p.get("activation"), y)]
+
+
+register_op(OperatorType.POOL2D, _pool2d_infer, _pool2d_lower)
+
+
+def _flat_infer(layer: Layer):
+    x = layer.inputs[0].spec
+    n = x.shape[0]
+    rest = 1
+    for d in x.shape[1:]:
+        rest *= d
+    return [x.with_shape((n, rest))]
+
+
+register_op(
+    OperatorType.FLAT,
+    _flat_infer,
+    lambda l, i, w, c: [i[0].reshape(i[0].shape[0], -1)],
+)
+
+
+def _bn_infer(layer: Layer):
+    x = layer.inputs[0].spec  # NCHW (or NC for 2-d input)
+    c = x.shape[1]
+    layer.weight_specs = {
+        "gamma": TensorSpec((c,), x.dtype),
+        "beta": TensorSpec((c,), x.dtype),
+    }
+    return [x]
+
+
+def _bn_lower(layer: Layer, inputs, weights, ctx: LoweringCtx):
+    x = inputs[0]
+    eps = layer.params.get("eps", 1e-5)
+    momentum = layer.params.get("momentum", 0.9)
+    axes = tuple(i for i in range(x.ndim) if i != 1)
+    bshape = [1] * x.ndim
+    bshape[1] = x.shape[1]
+    mean_key, var_key = f"{layer.name}/mean", f"{layer.name}/var"
+    if ctx.training:
+        mean = jnp.mean(x, axis=axes)
+        var = jnp.var(x, axis=axes)
+        rm = ctx.state.get(mean_key, jnp.zeros_like(mean))
+        rv = ctx.state.get(var_key, jnp.ones_like(var))
+        ctx.new_state[mean_key] = momentum * rm + (1 - momentum) * mean
+        ctx.new_state[var_key] = momentum * rv + (1 - momentum) * var
+    else:
+        mean = ctx.state.get(mean_key, jnp.zeros((x.shape[1],), x.dtype))
+        var = ctx.state.get(var_key, jnp.ones((x.shape[1],), x.dtype))
+    y = (x - mean.reshape(bshape)) * lax.rsqrt(var.reshape(bshape) + eps)
+    y = y * weights["gamma"].reshape(bshape) + weights["beta"].reshape(bshape)
+    if layer.params.get("relu", False):
+        y = jax.nn.relu(y)
+    return [y]
+
+
+register_op(OperatorType.BATCHNORM, _bn_infer, _bn_lower)

@@ -1,0 +1,76 @@
+"""Linear (dense) and batched matmul — the MXU workhorses.
+
+Reference analog: src/ops/linear.cc (1184 LoC, cuBLAS) and batch_matmul.cc
+(711, cuBLAS strided batched). On TPU both lower to single dot_generals that
+XLA tiles onto the MXU; activation and bias fuse in.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+from typing import TYPE_CHECKING
+if TYPE_CHECKING:
+    from flexflow_tpu.core.layer import Layer
+from flexflow_tpu.core.tensor import TensorSpec
+from flexflow_tpu.ops.op_type import OperatorType
+from flexflow_tpu.ops.registry import register_op
+from flexflow_tpu.ops.activations import apply_activation
+
+
+def _linear_infer(layer: Layer):
+    (x,) = [t.spec for t in layer.inputs]
+    out_dim = int(layer.params["out_dim"])
+    in_dim = x.shape[-1]
+    layer.weight_specs = {"kernel": TensorSpec((in_dim, out_dim), x.dtype)}
+    if layer.params.get("use_bias", True):
+        layer.weight_specs["bias"] = TensorSpec((out_dim,), x.dtype)
+    return [x.with_shape(x.shape[:-1] + (out_dim,))]
+
+
+def _linear_lower(layer: Layer, inputs, weights, ctx):
+    x = inputs[0]
+    y = x @ weights["kernel"].astype(x.dtype)
+    if "bias" in weights:
+        y = y + weights["bias"].astype(y.dtype)
+    return [apply_activation(layer.params.get("activation"), y)]
+
+
+def _linear_flops(layer: Layer):
+    x = layer.inputs[0].spec
+    return 2.0 * x.num_elements * layer.params["out_dim"]
+
+
+register_op(OperatorType.LINEAR, _linear_infer, _linear_lower, _linear_flops)
+
+
+def _bmm_infer(layer: Layer):
+    a, b = [t.spec for t in layer.inputs]
+    if a.shape[:-2] != b.shape[:-2] or a.shape[-1] != b.shape[-2]:
+        raise ValueError(f"batch_matmul shape mismatch {a} @ {b}")
+    return [a.with_shape(a.shape[:-1] + (b.shape[-1],))]
+
+
+def _bmm_lower(layer: Layer, inputs, weights, ctx):
+    a, b = inputs
+    # seq-length truncation (reference: batch_matmul a/b_seq_length_dim,
+    # include/flexflow/model.h:481-485): a static slice when configured.
+    sl = ctx.seq_length
+    if sl is not None:
+        if layer.params.get("a_seq_length_dim", -1) >= 0:
+            d = layer.params["a_seq_length_dim"]
+            a = jnp.take(a, jnp.arange(sl), axis=d) if a.shape[d] > sl else a
+        if layer.params.get("b_seq_length_dim", -1) >= 0:
+            d = layer.params["b_seq_length_dim"]
+            b = jnp.take(b, jnp.arange(sl), axis=d) if b.shape[d] > sl else b
+    return [jnp.matmul(a, b)]
+
+
+def _bmm_flops(layer: Layer):
+    a, b = [t.spec for t in layer.inputs]
+    return 2.0 * a.num_elements * b.shape[-1]
+
+
+register_op(OperatorType.BATCHMATMUL, _bmm_infer, _bmm_lower, _bmm_flops)

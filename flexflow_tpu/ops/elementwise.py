@@ -1,0 +1,111 @@
+"""Elementwise unary/binary ops and cast.
+
+Reference analog: src/ops/element_unary.cc (720 LoC), element_binary.cc (812),
+cast.cc (366) + their CUDA kernels. On TPU these are single jnp calls that XLA
+fuses into neighbors; no hand-written kernels needed (VPU ops).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from typing import TYPE_CHECKING
+if TYPE_CHECKING:
+    from flexflow_tpu.core.layer import Layer
+from flexflow_tpu.core.tensor import TensorSpec
+from flexflow_tpu.dtype import DataType
+from flexflow_tpu.ops.op_type import OperatorType, UNARY_OPS, BINARY_OPS
+from flexflow_tpu.ops.registry import register_op
+
+
+_UNARY_FNS = {
+    OperatorType.RELU: jax.nn.relu,
+    OperatorType.IDENTITY: lambda x: x,
+    OperatorType.SIGMOID: jax.nn.sigmoid,
+    OperatorType.TANH: jnp.tanh,
+    OperatorType.ELU: jax.nn.elu,
+    OperatorType.GELU: jax.nn.gelu,
+    OperatorType.EXP: jnp.exp,
+    OperatorType.LOG: jnp.log,
+    OperatorType.SIN: jnp.sin,
+    OperatorType.COS: jnp.cos,
+    OperatorType.SQRT: jnp.sqrt,
+    OperatorType.RSQRT: jax.lax.rsqrt,
+    OperatorType.SILU: jax.nn.silu,
+}
+
+
+def _unary_infer(layer: Layer):
+    return [layer.inputs[0].spec]
+
+
+def _unary_lower(layer: Layer, inputs, weights, ctx):
+    x = inputs[0]
+    t = layer.op_type
+    if t is OperatorType.POW:
+        return [jnp.power(x, layer.params["exponent"])]
+    if t is OperatorType.SCALAR_MULTIPLY:
+        return [x * layer.params["scalar"]]
+    if t is OperatorType.SCALAR_ADD:
+        return [x + layer.params["scalar"]]
+    if t is OperatorType.SCALAR_SUB:
+        return [x - layer.params["scalar"]]
+    if t is OperatorType.SCALAR_TRUE_DIV:
+        return [x / layer.params["scalar"]]
+    if t is OperatorType.SCALAR_FLOOR_DIV:
+        return [jnp.floor_divide(x, layer.params["scalar"])]
+    return [_UNARY_FNS[t](x)]
+
+
+for _t in UNARY_OPS:
+    register_op(_t, _unary_infer, _unary_lower)
+
+
+_BINARY_FNS = {
+    OperatorType.EW_ADD: jnp.add,
+    OperatorType.EW_SUB: jnp.subtract,
+    OperatorType.EW_MUL: jnp.multiply,
+    OperatorType.EW_DIV: jnp.divide,
+    OperatorType.EW_MAX: jnp.maximum,
+    OperatorType.EW_MIN: jnp.minimum,
+    OperatorType.EW_EQUAL: jnp.equal,
+    OperatorType.EW_GREATER: jnp.greater,
+    OperatorType.EW_LESS: jnp.less,
+}
+
+_BOOL_OUT = {OperatorType.EW_EQUAL, OperatorType.EW_GREATER, OperatorType.EW_LESS}
+
+
+def _binary_infer(layer: Layer):
+    a, b = layer.inputs[0].spec, layer.inputs[1].spec
+    shape = jnp.broadcast_shapes(a.shape, b.shape)
+    dtype = DataType.BOOL if layer.op_type in _BOOL_OUT else a.dtype
+    return [TensorSpec(shape, dtype)]
+
+
+def _binary_lower(layer: Layer, inputs, weights, ctx):
+    return [_BINARY_FNS[layer.op_type](inputs[0], inputs[1])]
+
+
+for _t in BINARY_OPS:
+    register_op(_t, _binary_infer, _binary_lower)
+
+
+def _cast_infer(layer: Layer):
+    return [layer.inputs[0].spec.with_dtype(DataType.from_any(layer.params["dtype"]))]
+
+
+def _cast_lower(layer: Layer, inputs, weights, ctx):
+    return [inputs[0].astype(DataType.from_any(layer.params["dtype"]).jnp_dtype)]
+
+
+register_op(OperatorType.CAST, _cast_infer, _cast_lower)
+
+
+def _noop_infer(layer: Layer):
+    return [layer.inputs[0].spec]
+
+
+register_op(OperatorType.NOOP, _noop_infer, lambda l, i, w, c: [i[0]])
+register_op(OperatorType.INPUT, _noop_infer, lambda l, i, w, c: [i[0]])

@@ -1,0 +1,50 @@
+"""Embedding lookup (reference: src/ops/embedding.cc, 1205 LoC custom CUDA).
+
+Semantics follow the reference: input int ids of shape (batch, seq); with
+aggr="none" output is (batch, seq, out_dim); with aggr="sum"/"avg" the seq
+dim is pooled away — the DLRM sparse-feature path. The table is the prime
+target for attribute (entry-dim) parallelism; one-hot-matmul lowering is used
+for small vocab so the lookup rides the MXU, take() otherwise.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from typing import TYPE_CHECKING
+if TYPE_CHECKING:
+    from flexflow_tpu.core.layer import Layer
+from flexflow_tpu.core.tensor import TensorSpec
+from flexflow_tpu.dtype import DataType
+from flexflow_tpu.ops.op_type import OperatorType
+from flexflow_tpu.ops.registry import register_op
+
+
+def _emb_infer(layer: Layer):
+    x = layer.inputs[0].spec
+    p = layer.params
+    out_dim = p["out_dim"]
+    dtype = DataType.from_any(p.get("dtype", "float32"))
+    layer.weight_specs = {"kernel": TensorSpec((p["num_entries"], out_dim), dtype)}
+    if p.get("aggr", "none") == "none":
+        return [TensorSpec(x.shape + (out_dim,), dtype)]
+    return [TensorSpec(x.shape[:-1] + (out_dim,), dtype)]
+
+
+def _emb_lower(layer: Layer, inputs, weights, ctx):
+    ids = inputs[0].astype(jnp.int32)
+    table = weights["kernel"]
+    aggr = layer.params.get("aggr", "none")
+    y = jnp.take(table, ids, axis=0)
+    if aggr == "sum":
+        y = jnp.sum(y, axis=-2)
+    elif aggr == "avg":
+        y = jnp.mean(y, axis=-2)
+    return [y]
+
+
+def _emb_flops(layer: Layer):
+    return float(layer.outputs[0].spec.num_elements)
+
+
+register_op(OperatorType.EMBEDDING, _emb_infer, _emb_lower, _emb_flops)

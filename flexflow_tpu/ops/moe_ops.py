@@ -1,0 +1,133 @@
+"""MoE ops: group_by (dispatch), aggregate (combine), experts, cache.
+
+Reference analog: src/ops/{group_by.cc (534), aggregate.cc (569),
+aggregate_spec.cc (519), cache.cc (291)} — dynamic CUDA scatter/gather kernels.
+XLA needs static shapes, so the TPU-native design uses **capacity-factor
+routing** (the standard TPU MoE recipe): group_by emits a dense
+(n_experts, capacity, d) dispatch buffer + per-(token, choice) positions with
+overflow drops; `experts` is a batched per-expert dense (einsum over the expert
+dim, shardable on an "expert" mesh axis → expert parallelism with XLA
+all_to_alls); aggregate gathers back weighted by gate values.
+
+Semantics deviation from the reference (documented): the reference's group_by
+emits n separate variable-occupancy tensors; here occupancy is fixed at
+capacity = ceil(alpha * k * batch / n_experts) and overflow tokens are dropped
+(contribute zero), which is the established static-shape equivalent.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from typing import TYPE_CHECKING
+if TYPE_CHECKING:
+    from flexflow_tpu.core.layer import Layer
+from flexflow_tpu.core.tensor import TensorSpec
+from flexflow_tpu.dtype import DataType
+from flexflow_tpu.ops.op_type import OperatorType
+from flexflow_tpu.ops.registry import register_op
+from flexflow_tpu.ops.activations import apply_activation
+
+
+def _group_by_infer(layer: Layer):
+    data, assign = layer.inputs[0].spec, layer.inputs[1].spec
+    n_experts = layer.params["n_experts"]
+    alpha = layer.params.get("alpha", 1.0)
+    b, k = assign.shape
+    cap = max(1, int(math.ceil(alpha * k * b / n_experts)))
+    layer.params["capacity"] = cap
+    return [
+        TensorSpec((n_experts, cap, data.shape[-1]), data.dtype),
+        TensorSpec((b, k), DataType.INT32),
+    ]
+
+
+def _group_by_lower(layer: Layer, inputs, weights, ctx):
+    data, assign = inputs
+    n_experts = layer.params["n_experts"]
+    cap = layer.params["capacity"]
+    b, k = assign.shape
+    flat = assign.reshape(-1).astype(jnp.int32)  # (b*k,)
+    oh = jax.nn.one_hot(flat, n_experts, dtype=jnp.int32)  # (b*k, E)
+    # occurrence rank of each (token, choice) within its expert
+    pos = jnp.cumsum(oh, axis=0) * oh - 1
+    pos_own = jnp.max(pos, axis=1)  # (-1 cols elsewhere)
+    valid = pos_own < cap
+    slot = jnp.where(valid, pos_own, cap)  # collisions land in the overflow slot
+    tokens = jnp.repeat(data, k, axis=0)
+    buf = jnp.zeros((n_experts, cap + 1, data.shape[-1]), data.dtype)
+    buf = buf.at[flat, slot].set(tokens, mode="drop")
+    positions = jnp.where(valid, pos_own, -1).astype(jnp.int32).reshape(b, k)
+    return [buf[:, :cap], positions]
+
+
+register_op(OperatorType.GROUP_BY, _group_by_infer, _group_by_lower)
+
+
+def _experts_infer(layer: Layer):
+    x = layer.inputs[0].spec  # (E, cap, d)
+    p = layer.params
+    e, cap, d = x.shape
+    out_dim = p["out_dim"]
+    layer.weight_specs = {"kernel": TensorSpec((e, d, out_dim), x.dtype)}
+    if p.get("use_bias", True):
+        layer.weight_specs["bias"] = TensorSpec((e, out_dim), x.dtype)
+    return [x.with_shape((e, cap, out_dim))]
+
+
+def _experts_lower(layer: Layer, inputs, weights, ctx):
+    x = inputs[0]
+    y = jnp.einsum("ecd,edo->eco", x, weights["kernel"].astype(x.dtype))
+    if "bias" in weights:
+        y = y + weights["bias"].astype(y.dtype)[:, None, :]
+    return [apply_activation(layer.params.get("activation"), y)]
+
+
+def _experts_flops(layer: Layer):
+    x = layer.inputs[0].spec
+    return 2.0 * x.num_elements * layer.params["out_dim"]
+
+
+register_op(OperatorType.EXPERTS, _experts_infer, _experts_lower, _experts_flops)
+
+
+def _aggregate_infer(layer: Layer):
+    gates, assign, positions, exp = [t.spec for t in layer.inputs]
+    b, k = gates.shape
+    return [TensorSpec((b, exp.shape[-1]), exp.dtype)]
+
+
+def _aggregate_lower(layer: Layer, inputs, weights, ctx):
+    gates, assign, positions, exp = inputs
+    valid = positions >= 0
+    slot = jnp.where(valid, positions, 0)
+    gathered = exp[assign.astype(jnp.int32), slot]  # (b, k, dout)
+    w = jnp.where(valid, gates, 0.0).astype(exp.dtype)
+    return [jnp.einsum("bk,bkd->bd", w, gathered)]
+
+
+register_op(OperatorType.AGGREGATE, _aggregate_infer, _aggregate_lower)
+# aggregate_spec (reference: speculative-assignment variant used with Cache):
+# combine semantics are identical on the forward path.
+register_op(OperatorType.AGGREGATE_SPEC, _aggregate_infer, _aggregate_lower)
+
+
+def _cache_infer(layer: Layer):
+    return [layer.inputs[0].spec]
+
+
+def _cache_lower(layer: Layer, inputs, weights, ctx):
+    # Reference Cache (src/ops/cache.cc) memoizes expert assignments and scores
+    # drift via a user score function to drive recompile_on_condition. The TPU
+    # port keeps the passthrough + score in non-trainable state.
+    x = inputs[0]
+    key = f"{layer.name}/cached"
+    if ctx.training:
+        ctx.new_state[key] = x
+    return [x]
+
+
+register_op(OperatorType.CACHE, _cache_infer, _cache_lower)

@@ -1,0 +1,87 @@
+"""Reductions: reduce_sum/mean/max/min, mean, argmax/argmin, topk.
+
+Reference analog: src/ops/reduce.cc (423, cuDNN reduce), mean.cc (114),
+topk.cc (437, custom CUDA heap kernel — on TPU lax.top_k lowers to a sort
+network XLA schedules on the VPU).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from typing import TYPE_CHECKING
+if TYPE_CHECKING:
+    from flexflow_tpu.core.layer import Layer
+from flexflow_tpu.core.tensor import TensorSpec
+from flexflow_tpu.dtype import DataType
+from flexflow_tpu.ops.op_type import OperatorType
+from flexflow_tpu.ops.registry import register_op
+
+
+def _reduce_shape(x: TensorSpec, axes, keepdims: bool):
+    axes = sorted(a % x.ndim for a in axes)
+    if keepdims:
+        return tuple(1 if i in axes else d for i, d in enumerate(x.shape)), axes
+    return tuple(d for i, d in enumerate(x.shape) if i not in axes), axes
+
+
+def _reduce_infer(layer: Layer):
+    x = layer.inputs[0].spec
+    shape, axes = _reduce_shape(x, layer.params["axes"], layer.params.get("keepdims", False))
+    layer.params["axes"] = tuple(axes)
+    return [x.with_shape(shape)]
+
+
+_RFN = {
+    OperatorType.REDUCE_SUM: jnp.sum,
+    OperatorType.REDUCE_MEAN: jnp.mean,
+    OperatorType.REDUCE_MAX: jnp.max,
+    OperatorType.REDUCE_MIN: jnp.min,
+    OperatorType.MEAN: jnp.mean,
+}
+
+
+def _reduce_lower(layer: Layer, inputs, weights, ctx):
+    fn = _RFN[layer.op_type]
+    return [fn(inputs[0], axis=layer.params["axes"], keepdims=layer.params.get("keepdims", False))]
+
+
+for _t in (OperatorType.REDUCE_SUM, OperatorType.REDUCE_MEAN, OperatorType.REDUCE_MAX,
+           OperatorType.REDUCE_MIN, OperatorType.MEAN):
+    register_op(_t, _reduce_infer, _reduce_lower)
+
+
+def _arg_infer(layer: Layer):
+    x = layer.inputs[0].spec
+    axis = layer.params.get("axis", -1) % x.ndim
+    layer.params["axis"] = axis
+    shape = tuple(d for i, d in enumerate(x.shape) if i != axis)
+    return [TensorSpec(shape, DataType.INT32)]
+
+
+register_op(
+    OperatorType.ARGMAX,
+    _arg_infer,
+    lambda l, i, w, c: [jnp.argmax(i[0], axis=l.params["axis"]).astype(jnp.int32)],
+)
+register_op(
+    OperatorType.ARGMIN,
+    _arg_infer,
+    lambda l, i, w, c: [jnp.argmin(i[0], axis=l.params["axis"]).astype(jnp.int32)],
+)
+
+
+def _topk_infer(layer: Layer):
+    x = layer.inputs[0].spec
+    k = layer.params["k"]
+    shape = x.shape[:-1] + (k,)
+    return [x.with_shape(shape), TensorSpec(shape, DataType.INT32)]
+
+
+def _topk_lower(layer: Layer, inputs, weights, ctx):
+    vals, idx = lax.top_k(inputs[0], layer.params["k"])
+    return [vals, idx.astype(jnp.int32)]
+
+
+register_op(OperatorType.TOPK, _topk_infer, _topk_lower)

@@ -1,0 +1,86 @@
+"""Op registry: shape inference + JAX lowering + cost facts per OperatorType.
+
+Reference analog: the per-op C++ classes under src/ops/ (each with shape
+inference in its constructor, init/forward/backward Legion glue, and
+measure_operator_cost). In the TPU rebuild an op needs only:
+
+- ``infer(layer)``   — output TensorSpecs (+ fills layer.weight_specs);
+  the analog of the reference constructors' dim math.
+- ``lower(layer, inputs, weights, ctx)`` — a pure JAX function; XLA autodiff
+  replaces the reference's hand-written backward kernels, XLA fusion replaces
+  FusedOp's kernel dispatch loop (src/ops/fused.cu).
+- ``flops(layer)`` / default byte counts — feed the search cost model
+  (the measure_operator_cost analog is in flexflow_tpu/search/cost_model.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from typing import TYPE_CHECKING
+if TYPE_CHECKING:
+    from flexflow_tpu.core.layer import Layer
+from flexflow_tpu.core.tensor import TensorSpec
+from flexflow_tpu.ops.op_type import OperatorType
+
+
+@dataclasses.dataclass
+class LoweringCtx:
+    """Per-trace context threaded through op lowerings."""
+
+    training: bool = False
+    rng: Optional[jax.Array] = None
+    seq_length: Optional[int] = None  # FFIterationConfig.seq_length analog
+    # non-trainable state (batch-norm running stats, cache scores):
+    state: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    new_state: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def rng_for(self, layer: Layer) -> jax.Array:
+        if self.rng is None:
+            raise ValueError(f"layer {layer.name} needs an rng but none was provided")
+        return jax.random.fold_in(self.rng, layer.guid)
+
+
+@dataclasses.dataclass
+class OpDef:
+    infer: Callable[[Layer], List[TensorSpec]]
+    lower: Callable[[Layer, List[jnp.ndarray], Dict[str, jnp.ndarray], LoweringCtx], List[jnp.ndarray]]
+    flops: Optional[Callable[[Layer], float]] = None  # per forward pass
+
+    def flop_count(self, layer: Layer) -> float:
+        if self.flops is not None:
+            return float(self.flops(layer))
+        # default: one vector op per output element
+        return float(sum(o.spec.num_elements for o in layer.outputs))
+
+
+_REGISTRY: Dict[OperatorType, OpDef] = {}
+
+
+def register_op(op_type: OperatorType, infer, lower, flops=None) -> OpDef:
+    d = OpDef(infer=infer, lower=lower, flops=flops)
+    _REGISTRY[op_type] = d
+    return d
+
+
+def get_op_def(op_type: OperatorType) -> OpDef:
+    if op_type not in _REGISTRY:
+        raise NotImplementedError(f"no OpDef registered for {op_type}")
+    return _REGISTRY[op_type]
+
+
+def has_op_def(op_type: OperatorType) -> bool:
+    return op_type in _REGISTRY
+
+
+def io_bytes(layer: Layer) -> int:
+    """Bytes moved through HBM for one forward pass (inputs+weights+outputs)."""
+    n = sum(i.spec.size_bytes for i in layer.inputs)
+    n += sum(s.size_bytes for s in layer.weight_specs.values())
+    n += sum(o.spec.size_bytes for o in layer.outputs)
+    return n

@@ -1,0 +1,148 @@
+"""Shape/movement ops: reshape, transpose, concat, split, reverse, pad, slice,
+gather.
+
+Reference analog: src/ops/{reshape,transpose,concat,split,reverse,gather}.cc
+(~2.5k LoC of Legion glue + copy kernels). On TPU all of these are pure layout
+transformations XLA schedules for free or as single fused copies.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List
+
+import jax.numpy as jnp
+
+from typing import TYPE_CHECKING
+if TYPE_CHECKING:
+    from flexflow_tpu.core.layer import Layer
+from flexflow_tpu.core.tensor import TensorSpec
+from flexflow_tpu.ops.op_type import OperatorType
+from flexflow_tpu.ops.registry import register_op
+
+
+def _reshape_infer(layer: Layer):
+    x = layer.inputs[0].spec
+    shape = list(layer.params["shape"])
+    if shape.count(-1) > 1:
+        raise ValueError("at most one -1 in reshape")
+    if -1 in shape:
+        known = math.prod(d for d in shape if d != -1)
+        shape[shape.index(-1)] = x.num_elements // known
+    if math.prod(shape) != x.num_elements:
+        raise ValueError(f"reshape {x.shape} -> {shape} element mismatch")
+    layer.params["shape"] = tuple(shape)
+    return [x.with_shape(shape)]
+
+
+register_op(
+    OperatorType.RESHAPE,
+    _reshape_infer,
+    lambda l, i, w, c: [i[0].reshape(l.params["shape"])],
+)
+
+
+def _transpose_infer(layer: Layer):
+    x = layer.inputs[0].spec
+    perm = tuple(p % x.ndim for p in layer.params["perm"])
+    layer.params["perm"] = perm
+    return [x.with_shape(tuple(x.shape[p] for p in perm))]
+
+
+register_op(
+    OperatorType.TRANSPOSE,
+    _transpose_infer,
+    lambda l, i, w, c: [jnp.transpose(i[0], l.params["perm"])],
+)
+
+
+def _concat_infer(layer: Layer):
+    specs = [t.spec for t in layer.inputs]
+    axis = layer.params["axis"] % specs[0].ndim
+    layer.params["axis"] = axis
+    shape = list(specs[0].shape)
+    shape[axis] = sum(s.shape[axis] for s in specs)
+    return [specs[0].with_shape(shape)]
+
+
+register_op(
+    OperatorType.CONCAT,
+    _concat_infer,
+    lambda l, i, w, c: [jnp.concatenate(i, axis=l.params["axis"])],
+)
+
+
+def _split_infer(layer: Layer):
+    x = layer.inputs[0].spec
+    axis = layer.params["axis"] % x.ndim
+    layer.params["axis"] = axis
+    sizes: List[int] = list(layer.params["sizes"])
+    if sum(sizes) != x.shape[axis]:
+        raise ValueError(f"split sizes {sizes} != dim {x.shape[axis]}")
+    out = []
+    for s in sizes:
+        shape = list(x.shape)
+        shape[axis] = s
+        out.append(x.with_shape(shape))
+    return out
+
+
+def _split_lower(layer: Layer, inputs, weights, ctx):
+    x = inputs[0]
+    axis = layer.params["axis"]
+    sizes = layer.params["sizes"]
+    offsets = [0]
+    for s in sizes:
+        offsets.append(offsets[-1] + s)
+    return [jnp.take(x, jnp.arange(offsets[k], offsets[k + 1]), axis=axis) for k in range(len(sizes))]
+
+
+register_op(OperatorType.SPLIT, _split_infer, _split_lower)
+
+
+register_op(
+    OperatorType.REVERSE,
+    lambda l: [l.inputs[0].spec],
+    lambda l, i, w, c: [jnp.flip(i[0], axis=l.params["axis"])],
+)
+
+
+def _pad_infer(layer: Layer):
+    x = layer.inputs[0].spec
+    pads = layer.params["pads"]  # [(lo, hi)] * ndim
+    shape = tuple(d + lo + hi for d, (lo, hi) in zip(x.shape, pads))
+    return [x.with_shape(shape)]
+
+
+register_op(
+    OperatorType.PAD,
+    _pad_infer,
+    lambda l, i, w, c: [jnp.pad(i[0], l.params["pads"], constant_values=l.params.get("value", 0))],
+)
+
+
+def _slice_infer(layer: Layer):
+    x = layer.inputs[0].spec
+    starts, limits = layer.params["starts"], layer.params["limits"]
+    shape = tuple(hi - lo for lo, hi in zip(starts, limits))
+    return [x.with_shape(shape)]
+
+
+register_op(
+    OperatorType.SLICE,
+    _slice_infer,
+    lambda l, i, w, c: [jnp.asarray(i[0])[tuple(slice(lo, hi) for lo, hi in zip(l.params["starts"], l.params["limits"]))]],
+)
+
+
+def _gather_infer(layer: Layer):
+    data, index = layer.inputs[0].spec, layer.inputs[1].spec
+    # torch.gather semantics along `dim` (reference: src/ops/gather.cc)
+    return [data.with_shape(index.shape)]
+
+
+register_op(
+    OperatorType.GATHER,
+    _gather_infer,
+    lambda l, i, w, c: [jnp.take_along_axis(i[0], i[1], axis=l.params["dim"])],
+)

@@ -1,0 +1,55 @@
+"""Optimizers: SGD + Adam.
+
+Reference analog: include/flexflow/optimizer.h:36-110, src/runtime/optimizer.cc
+and optimizer_kernel.cu — where the reference fuses an ncclAllReduce of the
+gradients into the update task (optimizer_kernel.cu:88,196). On TPU the update
+is part of the single jitted SPMD train step: when weights are replicated over
+the data axis, XLA inserts the gradient all-reduce (psum over ICI) at the
+jax.grad boundary automatically, which is exactly the NCCL-fused-update
+semantics. Implementations are optax GradientTransformations (the idiomatic
+JAX optimizer algebra), wrapped in classes mirroring the reference Python API
+(python/flexflow/core/flexflow_cffi.py SGDOptimizer/AdamOptimizer).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import optax
+
+
+class Optimizer:
+    def to_optax(self) -> optax.GradientTransformation:
+        raise NotImplementedError
+
+
+class SGDOptimizer(Optimizer):
+    def __init__(self, ffmodel=None, lr: float = 0.01, momentum: float = 0.0,
+                 nesterov: bool = False, weight_decay: float = 0.0):
+        self.lr = lr
+        self.momentum = momentum
+        self.nesterov = nesterov
+        self.weight_decay = weight_decay
+
+    def to_optax(self) -> optax.GradientTransformation:
+        parts = []
+        if self.weight_decay:
+            parts.append(optax.add_decayed_weights(self.weight_decay))
+        parts.append(optax.sgd(self.lr, momentum=self.momentum or None, nesterov=self.nesterov))
+        return optax.chain(*parts)
+
+
+class AdamOptimizer(Optimizer):
+    def __init__(self, ffmodel=None, alpha: float = 0.001, beta1: float = 0.9,
+                 beta2: float = 0.999, weight_decay: float = 0.0, epsilon: float = 1e-8):
+        self.alpha = alpha
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.weight_decay = weight_decay
+        self.epsilon = epsilon
+
+    def to_optax(self) -> optax.GradientTransformation:
+        if self.weight_decay:
+            return optax.adamw(self.alpha, b1=self.beta1, b2=self.beta2,
+                               eps=self.epsilon, weight_decay=self.weight_decay)
+        return optax.adam(self.alpha, b1=self.beta1, b2=self.beta2, eps=self.epsilon)

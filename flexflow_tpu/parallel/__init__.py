@@ -1,0 +1,4 @@
+from flexflow_tpu.parallel.machine import MachineSpec, build_mesh
+from flexflow_tpu.parallel.sharding import DimSharding, OpSharding, Strategy
+
+__all__ = ["MachineSpec", "build_mesh", "DimSharding", "OpSharding", "Strategy"]

@@ -1,0 +1,45 @@
+"""Baseline strategies built without search.
+
+Reference analog: the `--only-data-parallel` short-circuit
+(src/runtime/model.cc:2638-2642), which inserts a batch-dim Repartition of
+degree #devices before every op. Here the same thing is a Strategy that shards
+every batch-carrying dim over the "data" axis and replicates weights; gradient
+all-reduce falls out of jax.grad + GSPMD (the NCCL analog, SURVEY.md N2→N4).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from flexflow_tpu.core.graph import topo_order
+from flexflow_tpu.parallel.machine import MachineSpec
+from flexflow_tpu.parallel.sharding import OpSharding, Strategy
+
+
+def data_parallel_strategy(model, machine: MachineSpec, axis: str = "data") -> Strategy:
+    """Shard dim 0 of every batch-sized tensor over `axis`, replicate weights.
+
+    Batch identification is by size: a leading dim equal to the global batch
+    (graph-input dim 0). Sharding constraints never change semantics, so a
+    miss here only costs layout, never correctness.
+    """
+    if axis not in machine.mesh_axes:
+        axis = next(iter(machine.mesh_axes))
+    degree = machine.mesh_axes[axis]
+    batch_sizes = {t.shape[0] for t in model.input_tensors if t.ndim > 0}
+
+    def dims_for(shape) -> List:
+        dims: List = [None] * len(shape)
+        if shape and shape[0] in batch_sizes and shape[0] % degree == 0:
+            dims[0] = axis
+        return dims
+
+    st = Strategy(mesh_axes=dict(machine.mesh_axes), name="data_parallel")
+    for t in model.input_tensors:
+        st.input_shardings[t.name] = dims_for(t.shape)
+    for layer in topo_order(model.layers):
+        st.op_shardings[layer.name] = OpSharding(
+            outputs=[dims_for(o.spec.shape) for o in layer.outputs],
+            weights={w: [None] * len(s.shape) for w, s in layer.weight_specs.items()},
+        )
+    return st

@@ -1,0 +1,121 @@
+"""Machine description: logical mesh + hardware coefficients.
+
+Reference analog: MachineView/MachineResource (include/flexflow/machine_view.h)
+and the simulator's MachineModel hierarchy (include/flexflow/simulator.h:
+212-605, src/runtime/machine_model.cc) describing NVLink/PCIe/NIC topology.
+The TPU equivalent is much simpler by design: placement is a named
+`jax.sharding.Mesh`, and the cost model needs only per-chip compute/HBM rates
+plus per-mesh-axis interconnect bandwidth (ICI for intra-slice axes, DCN for
+multi-slice axes). Numbers are per-chip, bidirectional-link aggregate.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+
+# Built-in chip models (public spec-sheet numbers).
+CHIP_PRESETS = {
+    # name: (bf16 FLOP/s, HBM bytes/s, HBM bytes, ICI bytes/s per axis)
+    "v5e": (197e12, 819e9, 16e9, 2 * 45e9),
+    "v5p": (459e12, 2765e9, 95e9, 2 * 100e9),
+    "v4": (275e12, 1228e9, 32e9, 2 * 50e9),
+    "cpu-sim": (1e11, 50e9, 8e9, 1e9),
+}
+
+
+@dataclasses.dataclass
+class MachineSpec:
+    """The machine the search optimizes for (may be larger than the real one,
+    reference: --search-num-nodes, config.h:154-155)."""
+
+    mesh_axes: Dict[str, int] = dataclasses.field(default_factory=dict)  # ordered
+    chip: str = "v5e"
+    flops: float = 0.0  # bf16 peak per chip
+    hbm_bw: float = 0.0
+    hbm_bytes: float = 0.0
+    ici_bw: Dict[str, float] = dataclasses.field(default_factory=dict)  # per axis
+    dcn_axes: Tuple[str, ...] = ()  # axes that cross slices (DCN bandwidth)
+    dcn_bw: float = 25e9
+    mxu_flop_overhead: float = 1.4  # achievable-fraction fudge: peak/this
+
+    def __post_init__(self):
+        preset = CHIP_PRESETS.get(self.chip, CHIP_PRESETS["v5e"])
+        if not self.flops:
+            self.flops = preset[0]
+        if not self.hbm_bw:
+            self.hbm_bw = preset[1]
+        if not self.hbm_bytes:
+            self.hbm_bytes = preset[2]
+        for ax in self.mesh_axes:
+            if ax not in self.ici_bw:
+                self.ici_bw[ax] = self.dcn_bw if ax in self.dcn_axes else preset[3]
+
+    @property
+    def num_devices(self) -> int:
+        return math.prod(self.mesh_axes.values()) if self.mesh_axes else 1
+
+    def axis_bw(self, axis: str) -> float:
+        return self.ici_bw.get(axis, CHIP_PRESETS.get(self.chip, CHIP_PRESETS["v5e"])[3])
+
+    # -------------------------------------------------------------- io
+    def to_json(self) -> dict:
+        return {
+            "mesh_axes": self.mesh_axes,
+            "chip": self.chip,
+            "flops": self.flops,
+            "hbm_bw": self.hbm_bw,
+            "hbm_bytes": self.hbm_bytes,
+            "ici_bw": self.ici_bw,
+            "dcn_axes": list(self.dcn_axes),
+        }
+
+    @staticmethod
+    def from_json(d: dict) -> "MachineSpec":
+        return MachineSpec(
+            mesh_axes=dict(d["mesh_axes"]),
+            chip=d.get("chip", "v5e"),
+            flops=d.get("flops", 0.0),
+            hbm_bw=d.get("hbm_bw", 0.0),
+            hbm_bytes=d.get("hbm_bytes", 0.0),
+            ici_bw=dict(d.get("ici_bw", {})),
+            dcn_axes=tuple(d.get("dcn_axes", ())),
+        )
+
+    @staticmethod
+    def from_file(path: str) -> "MachineSpec":
+        with open(path) as f:
+            return MachineSpec.from_json(json.load(f))
+
+    @staticmethod
+    def detect(mesh_axes: Optional[Dict[str, int]] = None) -> "MachineSpec":
+        """Build a spec for the visible devices (the reference's machine
+        discovery in FFConfig; src/runtime/model.cc FFConfig ctor)."""
+        devs = jax.devices()
+        chip = "cpu-sim" if devs[0].platform == "cpu" else "v5e"
+        kind = getattr(devs[0], "device_kind", "").lower()
+        if "v5p" in kind or "v5 p" in kind:
+            chip = "v5p"
+        elif "v4" in kind:
+            chip = "v4"
+        if not mesh_axes:
+            mesh_axes = {"data": len(devs)}
+        return MachineSpec(mesh_axes=dict(mesh_axes), chip=chip)
+
+
+def build_mesh(spec: MachineSpec) -> jax.sharding.Mesh:
+    """Materialize the logical mesh over the visible devices."""
+    shape = tuple(spec.mesh_axes.values())
+    names = tuple(spec.mesh_axes.keys())
+    n = math.prod(shape)
+    devs = jax.devices()
+    if n > len(devs):
+        raise ValueError(f"mesh {spec.mesh_axes} needs {n} devices, have {len(devs)}")
+    arr = np.array(devs[:n]).reshape(shape)
+    return jax.sharding.Mesh(arr, names)

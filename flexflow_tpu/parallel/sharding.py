@@ -1,0 +1,137 @@
+"""Sharding strategy types — the MachineView/ParallelTensor analog.
+
+Reference analog: `MachineView` (include/flexflow/machine_view.h:14-96) plus
+`ParallelDim{size, degree, parallel_idx}` (include/flexflow/parallel_tensor.h:
+36-71). In the TPU-native design both collapse into one concept: a
+**DimSharding** assigns each tensor dim zero or more mesh axes (exactly a
+`jax.sharding.PartitionSpec`); an **OpSharding** gives the DimShardings of one
+op's outputs + weights; a **Strategy** maps every layer to an OpSharding.
+The four reference parallel ops are reshardings between DimShardings:
+
+  Repartition (src/parallel_ops/partition.cc) = add an axis to a dim
+  Combine     (src/parallel_ops/combine.cc)   = remove an axis from a dim
+  Replicate   (src/parallel_ops/replicate.cc) = no-op spec (axis unused by dims)
+  Reduction   (src/parallel_ops/reduction.cc) = psum over an axis (from matmul
+               contractions — XLA inserts it when a contracted dim is sharded)
+
+Strategies serialize to JSON (reference: --export-strategy / --import-strategy,
+src/runtime/model.cc:3609-3616).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+# One dim's assignment: None (replicated), "axis", or a tuple of axes.
+DimSharding = Union[None, str, Tuple[str, ...]]
+
+
+def _norm_dim(d) -> DimSharding:
+    if d is None or d == []:
+        return None
+    if isinstance(d, str):
+        return d
+    t = tuple(d)
+    return t[0] if len(t) == 1 else t
+
+
+def dims_to_pspec(dims: Sequence[DimSharding]) -> PartitionSpec:
+    return PartitionSpec(*[_norm_dim(d) for d in dims])
+
+
+def used_axes(dims: Sequence[DimSharding]):
+    out = []
+    for d in dims:
+        if d is None:
+            continue
+        out.extend([d] if isinstance(d, str) else list(d))
+    return out
+
+
+@dataclasses.dataclass
+class OpSharding:
+    """Per-op placement: output and weight dim shardings."""
+
+    outputs: List[List[DimSharding]] = dataclasses.field(default_factory=list)
+    weights: Dict[str, List[DimSharding]] = dataclasses.field(default_factory=dict)
+
+    def output_pspec(self, idx: int = 0) -> PartitionSpec:
+        if idx >= len(self.outputs):
+            return PartitionSpec()
+        return dims_to_pspec(self.outputs[idx])
+
+    def weight_pspec(self, name: str) -> PartitionSpec:
+        if name not in self.weights:
+            return PartitionSpec()
+        return dims_to_pspec(self.weights[name])
+
+    def to_json(self):
+        return {"outputs": self.outputs, "weights": self.weights}
+
+    @staticmethod
+    def from_json(d) -> "OpSharding":
+        return OpSharding(
+            outputs=[[_norm_dim(x) for x in o] for o in d.get("outputs", [])],
+            weights={k: [_norm_dim(x) for x in v] for k, v in d.get("weights", {}).items()},
+        )
+
+    def __str__(self):
+        def fmt(dims):
+            return "[" + ",".join("." if d is None else (d if isinstance(d, str) else "+".join(d)) for d in dims) + "]"
+
+        o = " ".join(fmt(x) for x in self.outputs)
+        w = " ".join(f"{k}{fmt(v)}" for k, v in self.weights.items())
+        return (o + (" | " + w if w else "")).strip()
+
+
+@dataclasses.dataclass
+class Strategy:
+    """A full parallelization strategy: the searched artifact.
+
+    Reference analog: the serialized optimal graph + per-node MachineViews
+    produced by Graph::graph_optimize_task (src/runtime/graph.cc:2162-2230).
+    """
+
+    op_shardings: Dict[str, OpSharding] = dataclasses.field(default_factory=dict)
+    input_shardings: Dict[str, List[DimSharding]] = dataclasses.field(default_factory=dict)
+    mesh_axes: Dict[str, int] = dataclasses.field(default_factory=dict)
+    name: str = "strategy"
+
+    def input_pspec(self, tensor_name: str) -> PartitionSpec:
+        if tensor_name not in self.input_shardings:
+            return PartitionSpec()
+        return dims_to_pspec(self.input_shardings[tensor_name])
+
+    def sharding_for(self, layer_name: str) -> OpSharding:
+        return self.op_shardings.get(layer_name, OpSharding())
+
+    # ----------------------------------------------------------------- io
+    def to_json(self) -> dict:
+        return {
+            "name": self.name,
+            "mesh_axes": self.mesh_axes,
+            "inputs": self.input_shardings,
+            "ops": {k: v.to_json() for k, v in self.op_shardings.items()},
+        }
+
+    def save(self, path: str):
+        with open(path, "w") as f:
+            json.dump(self.to_json(), f, indent=1)
+
+    @staticmethod
+    def from_json(d: dict) -> "Strategy":
+        return Strategy(
+            op_shardings={k: OpSharding.from_json(v) for k, v in d.get("ops", {}).items()},
+            input_shardings={k: [_norm_dim(x) for x in v] for k, v in d.get("inputs", {}).items()},
+            mesh_axes=dict(d.get("mesh_axes", {})),
+            name=d.get("name", "strategy"),
+        )
+
+    @staticmethod
+    def load(path: str) -> "Strategy":
+        with open(path) as f:
+            return Strategy.from_json(json.load(f))

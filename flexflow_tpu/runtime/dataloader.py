@@ -1,0 +1,89 @@
+"""Dataloaders.
+
+Reference analog: `SingleDataLoader` (include/flexflow/dataloader.h:34-120,
+src/dataloader/dataloader.cc) — full dataset pinned in zero-copy CPU memory,
+per-iteration index task scattering shard slices to device. The TPU-native
+equivalent keeps the dataset in host numpy and device_puts each batch with its
+NamedSharding: jax dispatches the host→HBM copies per shard asynchronously,
+which is the same scatter. A double-buffered prefetcher overlaps the next
+batch's transfer with the current step (the Legion-async analog); the native
+C++ loader (flexflow_tpu/native) accelerates shuffled batch assembly.
+"""
+
+from __future__ import annotations
+
+import threading
+import queue as queue_mod
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+
+class SingleDataLoader:
+    def __init__(self, xs: Sequence[np.ndarray], y: np.ndarray, batch_size: int,
+                 shuffle: bool = True, seed: int = 0, drop_remainder: bool = True):
+        self.xs = [np.asarray(x) for x in xs]
+        self.y = np.asarray(y)
+        n = self.y.shape[0]
+        for x in self.xs:
+            assert x.shape[0] == n, "all arrays must share the sample dim"
+        self.num_samples = n
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.rng = np.random.default_rng(seed)
+        self.drop_remainder = drop_remainder
+        try:
+            from flexflow_tpu.native import batch_gather  # C++ fast path
+
+            self._gather = batch_gather
+        except Exception:
+            self._gather = None
+
+    @property
+    def num_batches(self) -> int:
+        if self.drop_remainder:
+            return self.num_samples // self.batch_size
+        return (self.num_samples + self.batch_size - 1) // self.batch_size
+
+    def _take(self, arr: np.ndarray, idx: np.ndarray) -> np.ndarray:
+        if self._gather is not None and arr.dtype != object:
+            out = self._gather(arr, idx)
+            if out is not None:
+                return out
+        return arr[idx]
+
+    def epoch(self) -> Iterator[Tuple[List[np.ndarray], np.ndarray]]:
+        order = np.arange(self.num_samples)
+        if self.shuffle:
+            self.rng.shuffle(order)
+        for b in range(self.num_batches):
+            idx = order[b * self.batch_size:(b + 1) * self.batch_size]
+            yield [self._take(x, idx) for x in self.xs], self._take(self.y, idx)
+
+
+def prefetch_to_device(it, input_shardings, label_sharding, depth: int = 2):
+    """Overlap host→device transfer with compute (double buffering)."""
+    q: "queue_mod.Queue" = queue_mod.Queue(maxsize=depth)
+    _DONE = object()
+
+    def worker():
+        try:
+            for xs, y in it:
+                dx = [jax.device_put(x, s) if s is not None else jax.device_put(x)
+                      for x, s in zip(xs, input_shardings)]
+                dy = jax.device_put(y, label_sharding) if label_sharding is not None else jax.device_put(y)
+                q.put((dx, dy))
+            q.put(_DONE)
+        except BaseException as e:  # forward to the consumer, don't swallow
+            q.put(e)
+
+    t = threading.Thread(target=worker, daemon=True)
+    t.start()
+    while True:
+        item = q.get()
+        if item is _DONE:
+            break
+        if isinstance(item, BaseException):
+            raise item
+        yield item

@@ -1,0 +1,32 @@
+"""Test fixtures: run everything on a virtual 8-device CPU mesh.
+
+Reference analog: tests/multinode_helpers/mpi_wrapper (fake multi-node on one
+machine, SURVEY.md §4). Force the CPU platform BEFORE any jax backend init —
+the axon TPU plugin otherwise claims the platform (env vars are overridden by
+the site customization, so jax.config is the reliable lever).
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual cpu devices, got {devs}"
+    return devs
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

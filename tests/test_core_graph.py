@@ -1,0 +1,103 @@
+"""Unit tier: graph algorithms + shape inference (reference: tests/unit/*.cc)."""
+
+import numpy as np
+import pytest
+
+from flexflow_tpu import DataType, FFModel
+from flexflow_tpu.core.graph import dominators, post_dominators, to_dot, topo_order
+
+
+def build_diamond():
+    m = FFModel()
+    x = m.create_tensor([8, 16], name="x")
+    a = m.dense(x, 32, activation="relu", name="a")
+    b = m.dense(a, 32, name="b")
+    c = m.dense(a, 32, name="c")
+    d = m.add(b, c, name="d")
+    out = m.dense(d, 10, name="out")
+    return m, out
+
+
+def test_topo_order():
+    m, _ = build_diamond()
+    order = topo_order(m.layers)
+    pos = {l.name: i for i, l in enumerate(order)}
+    assert pos["a"] < pos["b"] and pos["a"] < pos["c"]
+    assert pos["b"] < pos["d"] and pos["c"] < pos["d"] < pos["out"]
+
+
+def test_dominators():
+    m, _ = build_diamond()
+    dom = dominators(m.layers)
+    byname = {l.name: l for l in m.layers}
+    # 'a' dominates the join 'd'; neither branch does
+    assert byname["a"] in dom[byname["d"]]
+    assert byname["b"] not in dom[byname["d"]]
+    pdom = post_dominators(m.layers)
+    assert byname["d"] in pdom[byname["a"]]
+
+
+def test_shape_inference_dense_conv():
+    m = FFModel()
+    x = m.create_tensor([4, 3, 32, 32])
+    c = m.conv2d(x, 16, 5, 5, 1, 1, 2, 2, activation="relu")
+    assert c.shape == (4, 16, 32, 32)
+    p = m.pool2d(c, 2, 2, 2, 2)
+    assert p.shape == (4, 16, 16, 16)
+    f = m.flat(p)
+    assert f.shape == (4, 16 * 16 * 16)
+    d = m.dense(f, 10)
+    assert d.shape == (4, 10)
+    lyr = d.owner
+    assert lyr.weight_specs["kernel"].shape == (4096, 10)
+
+
+def test_shape_inference_misc():
+    m = FFModel()
+    x = m.create_tensor([4, 8, 16])
+    t = m.transpose(x, [0, 2, 1])
+    assert t.shape == (4, 16, 8)
+    r = m.reshape(x, [4, -1])
+    assert r.shape == (4, 128)
+    parts = m.split(x, 2, axis=1)
+    assert len(parts) == 2 and parts[0].shape == (4, 4, 16)
+    cc = m.concat(parts, axis=1)
+    assert cc.shape == (4, 8, 16)
+    s = m.softmax(x)
+    assert s.shape == x.shape
+    vals, idx = m.top_k(x, 4)
+    assert vals.shape == (4, 8, 4) and idx.dtype == DataType.INT32
+    e = m.create_tensor([4, 6], DataType.INT32)
+    emb = m.embedding(e, 100, 32, aggr="sum")
+    assert emb.shape == (4, 32)
+    emb2 = m.embedding(e, 100, 32, aggr="none")
+    assert emb2.shape == (4, 6, 32)
+
+
+def test_mha_shapes():
+    m = FFModel()
+    q = m.create_tensor([2, 10, 64])
+    out = m.multihead_attention(q, q, q, 64, 8)
+    assert out.shape == (2, 10, 64)
+    lyr = out.owner
+    assert lyr.weight_specs["wq"].shape == (64, 64)
+
+
+def test_moe_shapes():
+    m = FFModel()
+    x = m.create_tensor([32, 16])
+    y = m.moe(x, num_exp=4, num_select=2, expert_hidden_size=16, alpha=2.0)
+    assert y.shape == (32, 16)
+
+
+def test_dot_export():
+    m, _ = build_diamond()
+    dot = to_dot(m.layers)
+    assert "digraph" in dot and "->" in dot
+
+
+def test_reshape_errors():
+    m = FFModel()
+    x = m.create_tensor([4, 8])
+    with pytest.raises(ValueError):
+        m.reshape(x, [5, 7])

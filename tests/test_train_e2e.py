@@ -1,0 +1,104 @@
+"""End-to-end training on the virtual 8-device CPU mesh (reference analog:
+tests/multi_gpu_tests.sh smoke runs with --only-data-parallel)."""
+
+import numpy as np
+import pytest
+
+from flexflow_tpu import AdamOptimizer, FFConfig, FFModel, LossType, MetricsType, SGDOptimizer
+from flexflow_tpu.dtype import DataType
+
+
+def make_blobs(n, dim, classes, rng):
+    centers = rng.normal(size=(classes, dim)) * 3
+    y = rng.integers(0, classes, size=n)
+    x = centers[y] + rng.normal(size=(n, dim))
+    return x.astype(np.float32), y.astype(np.int32)
+
+
+def test_mlp_trains_dp():
+    rng = np.random.default_rng(0)
+    x, y = make_blobs(512, 16, 4, rng)
+    cfg = FFConfig(batch_size=64, epochs=4, learning_rate=0.05, only_data_parallel=True)
+    m = FFModel(cfg)
+    t = m.create_tensor([64, 16], name="x")
+    h = m.dense(t, 64, activation="relu")
+    h = m.dense(h, 64, activation="relu")
+    out = m.dense(h, 4)
+    m.compile(SGDOptimizer(lr=0.05), LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+              [MetricsType.ACCURACY])
+    hist = m.fit(x, y, verbose=False)
+    assert hist[-1]["loss"] < hist[0]["loss"]
+    assert hist[-1]["accuracy"] > 0.8
+
+
+def test_mlp_sharded_over_mesh(devices):
+    # verify activations actually get sharded over 8 devices
+    cfg = FFConfig(batch_size=64, epochs=1, only_data_parallel=True)
+    m = FFModel(cfg)
+    t = m.create_tensor([64, 16], name="x")
+    out = m.dense(t, 8)
+    cm = m.compile(SGDOptimizer(lr=0.01), LossType.SPARSE_CATEGORICAL_CROSSENTROPY)
+    cm.init()
+    assert cm.mesh.devices.size == 8
+    sh = cm.input_sharding(m.input_tensors[0])
+    assert sh.spec[0] == "data"
+
+
+def test_cnn_trains():
+    rng = np.random.default_rng(1)
+    n, b = 256, 32
+    x = rng.normal(size=(n, 3, 16, 16)).astype(np.float32)
+    w = rng.normal(size=(3 * 16 * 16,))
+    y = (x.reshape(n, -1) @ w > 0).astype(np.int32)
+    cfg = FFConfig(batch_size=b, epochs=3, only_data_parallel=True)
+    m = FFModel(cfg)
+    t = m.create_tensor([b, 3, 16, 16])
+    c = m.conv2d(t, 8, 3, 3, 1, 1, 1, 1, activation="relu")
+    p = m.pool2d(c, 2, 2, 2, 2)
+    f = m.flat(p)
+    out = m.dense(f, 2)
+    m.compile(AdamOptimizer(alpha=1e-3), LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+              [MetricsType.ACCURACY])
+    hist = m.fit(x, y, verbose=False)
+    assert hist[-1]["loss"] < hist[0]["loss"]
+
+
+def test_batchnorm_dropout_train_eval():
+    rng = np.random.default_rng(2)
+    x, y = make_blobs(256, 8, 2, rng)
+    cfg = FFConfig(batch_size=32, epochs=2, only_data_parallel=True)
+    m = FFModel(cfg)
+    t = m.create_tensor([32, 8])
+    h = m.dense(t, 32, activation="relu")
+    h = m.dropout(h, 0.2)
+    out = m.dense(h, 2)
+    m.compile(SGDOptimizer(lr=0.05), LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+              [MetricsType.ACCURACY])
+    m.fit(x, y, verbose=False)
+    res = m.eval(x, y)
+    assert res["accuracy"] > 0.7
+
+
+def test_weight_get_set_roundtrip():
+    cfg = FFConfig(batch_size=8, only_data_parallel=True)
+    m = FFModel(cfg)
+    t = m.create_tensor([8, 4])
+    out = m.dense(t, 2, name="d1")
+    cm = m.compile(SGDOptimizer(), LossType.MEAN_SQUARED_ERROR)
+    cm.init()
+    w = cm.get_weight("d1", "kernel")
+    assert w.shape == (4, 2)
+    new = np.ones_like(w)
+    cm.set_weight("d1", "kernel", new)
+    np.testing.assert_allclose(cm.get_weight("d1", "kernel"), new)
+
+
+def test_forward_inference():
+    cfg = FFConfig(batch_size=4, only_data_parallel=True)
+    m = FFModel(cfg)
+    t = m.create_tensor([4, 4])
+    out = m.softmax(m.dense(t, 3))
+    m.compile(SGDOptimizer(), LossType.SPARSE_CATEGORICAL_CROSSENTROPY)
+    y = np.asarray(m.forward(np.ones((4, 4), np.float32)))
+    assert y.shape == (4, 3)
+    np.testing.assert_allclose(y.sum(-1), 1.0, rtol=1e-5)
