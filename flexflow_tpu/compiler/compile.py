@@ -55,6 +55,29 @@ def _pick_strategy(model, machine: MachineSpec) -> Strategy:
     return data_parallel_strategy(model, machine)
 
 
+def _overlay_parallel_ops(model, strategy: Strategy):
+    """Explicit parallel-op layers override the strategy's layout for their
+    outputs (reference: parallel ops ARE PCG nodes; here they are resharding
+    requests, see flexflow_tpu/ops/parallel_ops.py)."""
+    from flexflow_tpu.ops.op_type import PARALLEL_OPS
+    from flexflow_tpu.ops.parallel_ops import requested_dims
+    from flexflow_tpu.parallel.sharding import OpSharding
+
+    for layer in model.layers:
+        if layer.op_type not in PARALLEL_OPS:
+            continue
+        src = layer.inputs[0]
+        incoming = None
+        if src.owner is not None:
+            sh = strategy.op_shardings.get(src.owner.name)
+            if sh and src.owner_idx < len(sh.outputs):
+                incoming = sh.outputs[src.owner_idx]
+        elif src.name in strategy.input_shardings:
+            incoming = strategy.input_shardings[src.name]
+        dims = requested_dims(layer, incoming)
+        strategy.op_shardings[layer.name] = OpSharding(outputs=[dims])
+
+
 def compile_model(model, optimizer, loss_type: LossType, metrics: Sequence[MetricsType],
                   outputs: Optional[Sequence[Tensor]] = None) -> "CompiledModel":
     cfg = model.config
@@ -64,6 +87,7 @@ def compile_model(model, optimizer, loss_type: LossType, metrics: Sequence[Metri
         machine = MachineSpec.detect(cfg.mesh_shape)
     mesh = build_mesh(machine)
     strategy = _pick_strategy(model, machine)
+    _overlay_parallel_ops(model, strategy)
     if cfg.export_strategy_file:
         strategy.save(cfg.export_strategy_file)
     optimizer = optimizer or SGDOptimizer(lr=cfg.learning_rate)
@@ -133,13 +157,15 @@ class CompiledModel:
 
         def init_fn(key):
             params = {}
-            for layer in layers:
+            for li, layer in enumerate(layers):
                 if not layer.weight_specs:
                     continue
                 d = {}
                 for i, (wname, spec) in enumerate(sorted(layer.weight_specs.items())):
                     init = overrides.get((layer.name, wname)) or default_initializer(wname)
-                    k = jax.random.fold_in(jax.random.fold_in(key, layer.guid), i)
+                    # fold by topo position (not guid) so identically-built
+                    # models init identically across FFModel instances
+                    k = jax.random.fold_in(jax.random.fold_in(key, li), i)
                     d[wname] = init(k, spec)
                 params[layer.name] = d
             return params

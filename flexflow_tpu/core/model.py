@@ -319,6 +319,31 @@ class FFModel:
                               name=f"{name or 'moe'}_experts")
         return self.aggregate(topk_vals, topk_idx, positions, hidden, name=f"{name or 'moe'}_agg")
 
+    # parallel ops (reference: src/parallel_ops/) --------------------------
+    def repartition(self, input: Tensor, dim: int, axis: str = "data", name=None) -> Tensor:
+        return self._add_layer(OperatorType.REPARTITION, {"dim": dim, "axis": axis},
+                               [input], name)[0]
+
+    def combine(self, input: Tensor, dim: int, axis: str, name=None) -> Tensor:
+        return self._add_layer(OperatorType.COMBINE, {"dim": dim, "axis": axis},
+                               [input], name)[0]
+
+    def replicate(self, input: Tensor, name=None) -> Tensor:
+        return self._add_layer(OperatorType.REPLICATE, {}, [input], name)[0]
+
+    def reduction(self, input: Tensor, axis: str, name=None) -> Tensor:
+        return self._add_layer(OperatorType.REDUCTION, {"axis": axis}, [input], name)[0]
+
+    def all_to_all(self, input: Tensor, src_dim: int, dst_dim: int, axis: str,
+                   name=None) -> Tensor:
+        return self._add_layer(OperatorType.ALLTOALL,
+                               {"src_dim": src_dim, "dst_dim": dst_dim, "axis": axis},
+                               [input], name)[0]
+
+    def fused_parallel(self, input: Tensor, dims: Sequence, name=None) -> Tensor:
+        return self._add_layer(OperatorType.FUSED_PARALLEL, {"dims": tuple(dims)},
+                               [input], name)[0]
+
     # ------------------------------------------------------------- compile
     def compile(self, optimizer=None, loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
                 metrics: Sequence = (MetricsType.ACCURACY,), comp_mode=None,

@@ -1,0 +1,77 @@
+"""Explicit parallel ops: Repartition / Combine / Replicate / Reduction /
+AllToAll / FusedParallel.
+
+Reference analog: src/parallel_ops/{partition,combine,replicate,reduction,
+fused_parallel_op}.cc — data-movement tasks inserted into the PCG by the
+search. In the TPU-native design a parallel op is a *resharding request*: its
+lowering is the identity, and compile overlays the requested DimSharding onto
+the strategy so GSPMD emits the matching collective:
+
+  repartition(t, dim, axis)  → constraint shards `dim` over `axis`
+                               (dynamic-slice / all_to_all)
+  combine(t, dim, axis)      → constraint removes `axis` from `dim` (all_gather)
+  replicate(t)               → constraint fully replicates (all_gather)
+  reduction(t, axis)         → psum of partial results: under functional
+                               jax semantics partial sums only arise from
+                               sharded contraction dims, where XLA inserts the
+                               reduce-scatter/all-reduce itself; the explicit op
+                               pins the output layout after that reduction.
+  all_to_all(t, src, dst, axis) → reshard from dim src to dim dst over `axis`
+
+FusedParallelOp (a chain of the above collapsed into one movement,
+src/parallel_ops/fused_parallel_op.cc) is `fused_parallel(t, final_dims)` —
+one constraint straight to the final layout; XLA already fuses the collective
+sequence, which is why a single constraint is the whole implementation.
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, List, Optional
+
+if TYPE_CHECKING:
+    from flexflow_tpu.core.layer import Layer
+
+from flexflow_tpu.ops.op_type import OperatorType
+from flexflow_tpu.ops.registry import register_op
+
+
+def _identity_infer(layer: "Layer"):
+    return [layer.inputs[0].spec]
+
+
+def _identity_lower(layer, inputs, weights, ctx):
+    return [inputs[0]]
+
+
+for _t in (OperatorType.REPARTITION, OperatorType.COMBINE, OperatorType.REPLICATE,
+           OperatorType.REDUCTION, OperatorType.ALLTOALL, OperatorType.FUSED_PARALLEL):
+    register_op(_t, _identity_infer, _identity_lower)
+
+
+def requested_dims(layer: "Layer", current: Optional[List] = None) -> Optional[List]:
+    """The output DimSharding this parallel op requests, given the incoming
+    dims (None entries = replicated). Returns None for 'no opinion'."""
+    nd = layer.inputs[0].spec.ndim
+    dims = list(current) if current and len(current) == nd else [None] * nd
+    t = layer.op_type
+    p = layer.params
+    if t is OperatorType.REPARTITION:
+        dims[p["dim"] % nd] = p["axis"]
+    elif t is OperatorType.COMBINE:
+        d = p["dim"] % nd
+        cur = dims[d]
+        if cur == p["axis"]:
+            dims[d] = None
+        elif isinstance(cur, tuple):
+            dims[d] = tuple(a for a in cur if a != p["axis"]) or None
+    elif t is OperatorType.REPLICATE:
+        dims = [None] * nd
+    elif t is OperatorType.REDUCTION:
+        pass  # layout opinion only: keep incoming dims
+    elif t is OperatorType.ALLTOALL:
+        src, dst = p["src_dim"] % nd, p["dst_dim"] % nd
+        dims[src] = None
+        dims[dst] = p["axis"]
+    elif t is OperatorType.FUSED_PARALLEL:
+        dims = list(p["dims"])
+    return dims

@@ -1,0 +1,78 @@
+"""Expert strategy templates — hand-tuned overlays for common patterns.
+
+Reference analog: the pre-searched expert strategies shipped with the
+reference (examples/cpp/DLRM/strategies/*.pb) and the parallelization
+patterns its substitutions generate (src/runtime/substitution.cc:1726-1868):
+replicate-linear-combine / partition-linear-reduce (Megatron TP),
+partition-attention-over-heads, partitioned embedding tables.
+
+These are also the comparison anchors the auto-search must reach ≥90% of
+(BASELINE.md).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from flexflow_tpu.ops.op_type import OperatorType
+from flexflow_tpu.parallel.sharding import OpSharding, Strategy
+
+
+def apply_tensor_parallel_linear_pair(strategy: Strategy, up_layer, down_layer,
+                                      axis: str = "model"):
+    """Megatron MLP pattern: up kernel column-sharded, down kernel row-sharded.
+    The intermediate activation is sharded on its feature dim; XLA inserts one
+    psum after the down matmul (the Reduction parallel op of reference P2)."""
+    up, down = strategy.op_shardings[up_layer.name], strategy.op_shardings[down_layer.name]
+    up.weights["kernel"] = [None, axis]
+    if "bias" in up_layer.weight_specs:
+        up.weights["bias"] = [axis]
+    if up.outputs:
+        dims = list(up.outputs[0])
+        dims[-1] = axis
+        up.outputs[0] = dims
+    down.weights["kernel"] = [axis, None]
+    if "bias" in down_layer.weight_specs:
+        down.weights["bias"] = [None]
+
+
+def apply_tensor_parallel_attention(strategy: Strategy, mha_layer, axis: str = "model"):
+    """Head-parallel attention (reference: create_partition_attention_combine,
+    substitution.cc:1763-1770): shard qkv projections on the head (output)
+    dim, out-projection on its input dim."""
+    sh = strategy.op_shardings[mha_layer.name]
+    for w in ("wq", "wk", "wv"):
+        sh.weights[w] = [None, axis]
+    for b in ("bq", "bk", "bv"):
+        if b in mha_layer.weight_specs:
+            sh.weights[b] = [axis]
+    sh.weights["wo"] = [axis, None]
+    if "bo" in mha_layer.weight_specs:
+        sh.weights["bo"] = [None]
+
+
+def apply_sharded_embedding(strategy: Strategy, emb_layer, axis: str = "model",
+                            dim: int = 0):
+    """DLRM-style attribute-parallel embedding: shard the table over entries
+    (dim 0, reference embedding partition over entries) or features (dim 1)."""
+    sh = strategy.op_shardings[emb_layer.name]
+    dims = [None, None]
+    dims[dim] = axis
+    sh.weights["kernel"] = dims
+
+
+def apply_expert_parallel(strategy: Strategy, layers: Sequence, axis: str = "expert"):
+    """Expert parallelism: shard group_by dispatch buffers, expert weights and
+    expert outputs over the expert dim (reference P9: experts as separate ops
+    placed on different devices; here one einsum sharded over the expert axis
+    with XLA all_to_alls at the dispatch/combine boundaries)."""
+    for layer in layers:
+        sh = strategy.op_shardings[layer.name]
+        if layer.op_type is OperatorType.GROUP_BY:
+            nd0 = len(layer.outputs[0].spec.shape)
+            sh.outputs[0] = [axis] + [None] * (nd0 - 1)
+        elif layer.op_type is OperatorType.EXPERTS:
+            sh.weights["kernel"] = [axis, None, None]
+            if "bias" in layer.weight_specs:
+                sh.weights["bias"] = [axis, None]
+            sh.outputs[0] = [axis, None, None]
