@@ -44,6 +44,7 @@ class MachineSpec:
     dcn_axes: Tuple[str, ...] = ()  # axes that cross slices (DCN bandwidth)
     dcn_bw: float = 25e9
     mxu_flop_overhead: float = 1.4  # achievable-fraction fudge: peak/this
+    mxu_min_dim: int = 128  # lane width; shards thinner than this waste the MXU
 
     def __post_init__(self):
         preset = CHIP_PRESETS.get(self.chip, CHIP_PRESETS["v5e"])
@@ -74,6 +75,9 @@ class MachineSpec:
             "hbm_bytes": self.hbm_bytes,
             "ici_bw": self.ici_bw,
             "dcn_axes": list(self.dcn_axes),
+            "dcn_bw": self.dcn_bw,
+            "mxu_flop_overhead": self.mxu_flop_overhead,
+            "mxu_min_dim": self.mxu_min_dim,
         }
 
     @staticmethod
@@ -86,6 +90,9 @@ class MachineSpec:
             hbm_bytes=d.get("hbm_bytes", 0.0),
             ici_bw=dict(d.get("ici_bw", {})),
             dcn_axes=tuple(d.get("dcn_axes", ())),
+            dcn_bw=d.get("dcn_bw", 25e9),
+            mxu_flop_overhead=d.get("mxu_flop_overhead", 1.4),
+            mxu_min_dim=d.get("mxu_min_dim", 128),
         )
 
     @staticmethod

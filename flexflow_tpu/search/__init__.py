@@ -1,0 +1,11 @@
+"""Auto-parallelization search (the Unity analog, SURVEY.md C10-C14).
+
+Pipeline: candidate generation per op (substitution-rules analog) →
+frontier DP with beam pruning over the layer graph (SearchHelper DP analog) →
+Strategy. Costs from the analytic TPU model (simulator analog), optionally
+calibrated by on-device measurement.
+"""
+
+from flexflow_tpu.search.optimize import graph_optimize
+
+__all__ = ["graph_optimize"]

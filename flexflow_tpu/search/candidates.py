@@ -1,0 +1,259 @@
+"""Per-op sharding candidates — the substitution-rule generator.
+
+Reference analog: `generate_all_pcg_xfers` (src/runtime/substitution.cc:
+1726-1868) + `register_all_machine_views` (src/runtime/graph.cc:2329-2360):
+for every divisor degree the reference emits partition/replicate/combine/reduce
+rewrites per op family. Here each op family enumerates Candidate layouts over
+the mesh axes; the DP (search/dp.py) picks one per op, and reshard costs at
+the edges price the implied parallel ops.
+
+Axis convention: the axis named "data" (else the first axis) is the batch
+axis and is always used for batch-dim sharding when divisible (pure-DP is the
+always-present baseline candidate, reference --only-data-parallel). Other axes
+("model", "expert", "seq", ...) are enumerated for tensor/attribute/expert
+parallelism, gated by the reference's flags enable_parameter_parallel /
+enable_attribute_parallel.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import TYPE_CHECKING, Dict, List, Optional
+
+if TYPE_CHECKING:
+    from flexflow_tpu.core.layer import Layer
+
+from flexflow_tpu.ops.op_type import (
+    BINARY_OPS,
+    OperatorType,
+    PARALLEL_OPS,
+    UNARY_OPS,
+)
+from flexflow_tpu.ops.registry import get_op_def, io_bytes
+from flexflow_tpu.parallel.machine import MachineSpec
+from flexflow_tpu.parallel.sharding import DimSharding
+from flexflow_tpu.search import cost_model as cm
+
+
+@dataclasses.dataclass
+class Candidate:
+    """One way to place an op: wanted input layouts, produced output/weight
+    layouts, and the cost terms that don't live on graph edges."""
+
+    name: str
+    in_dims: List[List[DimSharding]]
+    out_dims: List[List[DimSharding]]
+    weight_dims: Dict[str, List[DimSharding]]
+    compute_degree: int = 1
+    extra_comm: float = 0.0  # collectives inherent to this placement (s)
+    eff: float = 1.0  # MXU-tile granularity efficiency (shards < 128 lanes waste MXU)
+
+    def op_time(self, layer: "Layer", machine: MachineSpec) -> float:
+        od = get_op_def(layer.op_type)
+        # per-device HBM traffic: activations divide by the compute degree,
+        # weights stream in full per replica (each device reads its own shard)
+        act_bytes = (sum(i.spec.size_bytes for i in layer.inputs)
+                     + sum(o.spec.size_bytes for o in layer.outputs))
+        w_bytes = sum(cm.shard_bytes(s, self.weight_dims.get(w, []), machine)
+                      for w, s in layer.weight_specs.items())
+        deg = max(1.0, self.compute_degree * self.eff)
+        hbm = act_bytes / deg + w_bytes
+        t = cm.compute_time(od.flop_count(layer), hbm, machine, deg,
+                            bytes_predivided=True)
+        t += self.extra_comm
+        t += cm.grad_sync_time(layer.weight_specs, self.weight_dims, machine,
+                               _batch_axes(machine))
+        return t
+
+    def mem_bytes(self, layer: "Layer", machine: MachineSpec) -> int:
+        # per-device: weights x4 (param, grad, 2 opt moments) + activations x2
+        m = 0
+        for w, spec in layer.weight_specs.items():
+            m += 4 * cm.shard_bytes(spec, self.weight_dims.get(w, []), machine)
+        for i, o in enumerate(layer.outputs):
+            m += 2 * cm.shard_bytes(o.spec, self.out_dims[i], machine)
+        return m
+
+
+def _batch_axes(machine: MachineSpec) -> List[str]:
+    if "data" in machine.mesh_axes:
+        return ["data"]
+    return [next(iter(machine.mesh_axes))] if machine.mesh_axes else []
+
+
+def _model_axes(machine: MachineSpec) -> List[str]:
+    b = set(_batch_axes(machine))
+    return [a for a in machine.mesh_axes if a not in b and machine.mesh_axes[a] > 1]
+
+
+def _dp_dims(shape, machine: MachineSpec, batch_sizes) -> List[DimSharding]:
+    dims: List[DimSharding] = [None] * len(shape)
+    for ax in _batch_axes(machine):
+        if shape and shape[0] in batch_sizes and shape[0] % machine.mesh_axes[ax] == 0:
+            dims[0] = ax
+            break
+    return dims
+
+
+def _ddeg(dims, machine):
+    return cm.dims_degree(dims, machine)
+
+
+def layer_candidates(layer: "Layer", machine: MachineSpec, batch_sizes,
+                     enable_parameter: bool = True,
+                     enable_attribute: bool = True) -> List[Candidate]:
+    t = layer.op_type
+    ispecs = [x.spec for x in layer.inputs]
+    ospecs = [o.spec for o in layer.outputs]
+    dp_in = [_dp_dims(s.shape, machine, batch_sizes) for s in ispecs]
+    dp_out = [_dp_dims(s.shape, machine, batch_sizes) for s in ospecs]
+    repl_w = {w: [None] * s.ndim for w, s in layer.weight_specs.items()}
+    dp = Candidate("dp", dp_in, dp_out, dict(repl_w),
+                   compute_degree=max(_ddeg(dp_out[0], machine) if dp_out else 1, 1))
+    cands = [dp]
+    maxes = _model_axes(machine) if enable_parameter else []
+
+    if t is OperatorType.LINEAR:
+        x, o = ispecs[0], ospecs[0]
+        for m in maxes:
+            dm = machine.mesh_axes[m]
+            base = max(1, dp.compute_degree)
+            if o.shape[-1] % dm == 0:
+                od = [list(dp_out[0][:-1]) + [m]]
+                cands.append(Candidate(
+                    f"tp_col:{m}", dp_in, od,
+                    {"kernel": [None, m], **({"bias": [m]} if "bias" in repl_w else {})},
+                    compute_degree=base * dm,
+                    eff=min(1.0, (o.shape[-1] // dm) / machine.mxu_min_dim)))
+            if x.shape[-1] % dm == 0:
+                ind = [list(dp_in[0][:-1]) + [m]]
+                out_bytes = cm.shard_bytes(o, dp_out[0], machine)
+                cands.append(Candidate(
+                    f"tp_row:{m}", ind, dp_out,
+                    {"kernel": [m, None], **({"bias": [None]} if "bias" in repl_w else {})},
+                    compute_degree=base * dm,
+                    extra_comm=cm.all_reduce_time(out_bytes, (m,), machine),
+                    eff=min(1.0, (x.shape[-1] // dm) / machine.mxu_min_dim)))
+
+    elif t is OperatorType.MULTIHEAD_ATTENTION:
+        heads = layer.params["num_heads"]
+        for m in maxes:
+            dm = machine.mesh_axes[m]
+            if heads % dm:
+                continue
+            wd = {w: [None, m] for w in ("wq", "wk", "wv")}
+            wd["wo"] = [m, None]
+            for b in ("bq", "bk", "bv"):
+                if b in repl_w:
+                    wd[b] = [m]
+            if "bo" in repl_w:
+                wd["bo"] = [None]
+            out_bytes = cm.shard_bytes(ospecs[0], dp_out[0], machine)
+            embed = layer.params["embed_dim"]
+            cands.append(Candidate(
+                f"tp_heads:{m}", dp_in, dp_out, wd,
+                compute_degree=max(1, dp.compute_degree) * dm,
+                extra_comm=cm.all_reduce_time(out_bytes, (m,), machine),
+                eff=min(1.0, (embed // dm) / machine.mxu_min_dim)))
+
+    elif t is OperatorType.EMBEDDING:
+        tbl = layer.weight_specs["kernel"]
+        for m in maxes:
+            dm = machine.mesh_axes[m]
+            if tbl.shape[0] % dm == 0:
+                out_bytes = cm.shard_bytes(ospecs[0], dp_out[0], machine)
+                cands.append(Candidate(
+                    f"row:{m}", dp_in, dp_out, {"kernel": [m, None]},
+                    compute_degree=max(1, dp.compute_degree) * dm,
+                    extra_comm=cm.all_reduce_time(out_bytes, (m,), machine)))
+            if tbl.shape[1] % dm == 0 and ospecs[0].shape[-1] % dm == 0:
+                od = [list(dp_out[0][:-1]) + [m]]
+                cands.append(Candidate(
+                    f"col:{m}", dp_in, od, {"kernel": [None, m]},
+                    compute_degree=max(1, dp.compute_degree) * dm,
+                    eff=min(1.0, (tbl.shape[1] // dm) / machine.mxu_min_dim)))
+
+    elif t is OperatorType.EXPERTS:
+        e = ispecs[0].shape[0]
+        for m in maxes:
+            dm = machine.mesh_axes[m]
+            if e % dm:
+                continue
+            ind = [[m, None, None]]
+            od = [[m, None, None]]
+            wd = {"kernel": [m, None, None]}
+            if "bias" in repl_w:
+                wd["bias"] = [m, None]
+            cands.append(Candidate(f"ep:{m}", ind, od, wd, compute_degree=dm))
+
+    elif t is OperatorType.GROUP_BY:
+        e = ospecs[0].shape[0]
+        for m in maxes:
+            dm = machine.mesh_axes[m]
+            if e % dm:
+                continue
+            od = [[m, None, None], dp_out[1]]
+            cands.append(Candidate(
+                f"ep:{m}", dp_in, od, {}, compute_degree=1,
+                extra_comm=cm.all_to_all_time(
+                    cm.shard_bytes(ospecs[0], [m, None, None], machine), (m,), machine)))
+
+    elif t is OperatorType.CONV2D and enable_attribute:
+        x, o = ispecs[0], ospecs[0]
+        for m in maxes:
+            dm = machine.mesh_axes[m]
+            # attribute parallel on H (reference P3); halo = (kernel_h-1) rows
+            if o.shape[2] % dm == 0 and x.shape[2] % dm == 0:
+                ind = [[dp_in[0][0], None, m, None]]
+                od = [[dp_out[0][0], None, m, None]]
+                batch_shard = x.shape[0] // max(1, _ddeg([dp_in[0][0]], machine))
+                halo_bytes = (layer.params["kernel_h"] - 1) * batch_shard \
+                    * x.shape[1] * x.shape[3] * x.dtype.itemsize
+                cands.append(Candidate(
+                    f"attr_h:{m}", ind, od, dict(repl_w),
+                    compute_degree=max(1, dp.compute_degree) * dm,
+                    extra_comm=halo_bytes / machine.axis_bw(m)))
+            # output-channel TP
+            if o.shape[1] % dm == 0:
+                od = [[dp_out[0][0], m, None, None]]
+                wd = {"kernel": [m, None, None, None]}
+                if "bias" in repl_w:
+                    wd["bias"] = [m]
+                cands.append(Candidate(
+                    f"tp_oc:{m}", dp_in, od, wd,
+                    compute_degree=max(1, dp.compute_degree) * dm))
+
+    elif t in UNARY_OPS or t in (OperatorType.DROPOUT, OperatorType.CAST,
+                                 OperatorType.SOFTMAX, OperatorType.LOG_SOFTMAX):
+        # propagate a feature-dim shard so TP chains stay sharded
+        x = ispecs[0]
+        for m in maxes:
+            dm = machine.mesh_axes[m]
+            if x.ndim >= 2 and x.shape[-1] % dm == 0 and t not in (
+                    OperatorType.SOFTMAX, OperatorType.LOG_SOFTMAX):
+                d = [list(dp_in[0][:-1]) + [m]]
+                cands.append(Candidate(f"follow:{m}", d, d, {},
+                                       compute_degree=max(1, dp.compute_degree) * dm,
+                                       eff=min(1.0, (x.shape[-1] // dm) / machine.mxu_min_dim)))
+
+    elif t in BINARY_OPS:
+        x = ospecs[0]
+        for m in maxes:
+            dm = machine.mesh_axes[m]
+            if x.ndim >= 2 and x.shape[-1] % dm == 0:
+                d = [list(dp_out[0][:-1]) + [m]]
+                cands.append(Candidate(f"follow:{m}", [d[0], d[0]], d, {},
+                                       compute_degree=max(1, dp.compute_degree) * dm,
+                                       eff=min(1.0, (x.shape[-1] // dm) / machine.mxu_min_dim)))
+
+    elif t in PARALLEL_OPS:
+        # explicit parallel op: its requested layout IS the candidate; pricing
+        # happens at the incoming edge (reshard incoming→requested), the op
+        # itself is free — so in_dims = out_dims = requested.
+        from flexflow_tpu.ops.parallel_ops import requested_dims
+
+        dims = requested_dims(layer)
+        return [Candidate("requested", [list(dims)], [list(dims)], {},
+                          compute_degree=1)]
+
+    return cands

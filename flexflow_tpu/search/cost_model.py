@@ -1,0 +1,134 @@
+"""Analytic TPU cost model.
+
+Reference analog: the Simulator + MachineModel stack (include/flexflow/
+simulator.h:212-778, src/runtime/simulator.cc) which replays a task graph of
+measured per-op costs over a modeled NVLink/PCIe/NIC topology. The TPU model
+is deliberately simpler and closed-form (the scaling-book recipe):
+
+  compute time  = max(flops / MXU rate, HBM bytes / HBM bw)   (roofline)
+  all_gather    = (k-1)/k * full_bytes / axis_bw
+  all_reduce    = 2 * (k-1)/k * bytes / axis_bw     (reduce-scatter+all-gather)
+  all_to_all    = (k-1)/k * shard_bytes / axis_bw
+  DCN axes use dcn_bw instead of ICI bw.
+
+Per-op measured calibration (the inner_measure_operator_cost analog,
+reference src/runtime/model.cu:38-74) is in flexflow_tpu/search/measure.py and
+replaces the roofline term when enabled.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Sequence
+
+from flexflow_tpu.core.tensor import TensorSpec
+from flexflow_tpu.parallel.machine import MachineSpec
+from flexflow_tpu.parallel.sharding import DimSharding
+
+
+def _axes_of(d: DimSharding) -> tuple:
+    if d is None:
+        return ()
+    return (d,) if isinstance(d, str) else tuple(d)
+
+
+def dims_degree(dims: Sequence[DimSharding], machine: MachineSpec) -> int:
+    deg = 1
+    for d in dims or ():
+        for a in _axes_of(d):
+            deg *= machine.mesh_axes.get(a, 1)
+    return deg
+
+
+def shard_bytes(spec: TensorSpec, dims: Sequence[DimSharding], machine: MachineSpec) -> int:
+    return spec.size_bytes // max(1, dims_degree(dims, machine))
+
+
+def _min_bw(axes, machine: MachineSpec) -> float:
+    return min((machine.axis_bw(a) for a in axes), default=machine.axis_bw("data"))
+
+
+def axis_degree(axes, machine: MachineSpec) -> int:
+    deg = 1
+    for a in axes:
+        deg *= machine.mesh_axes.get(a, 1)
+    return deg
+
+
+def all_gather_time(full_bytes: float, axes, machine: MachineSpec) -> float:
+    k = axis_degree(axes, machine)
+    if k <= 1:
+        return 0.0
+    return (k - 1) / k * full_bytes / _min_bw(axes, machine)
+
+
+def all_reduce_time(bytes_: float, axes, machine: MachineSpec) -> float:
+    k = axis_degree(axes, machine)
+    if k <= 1:
+        return 0.0
+    return 2.0 * (k - 1) / k * bytes_ / _min_bw(axes, machine)
+
+
+def all_to_all_time(shard_bytes_: float, axes, machine: MachineSpec) -> float:
+    k = axis_degree(axes, machine)
+    if k <= 1:
+        return 0.0
+    return (k - 1) / k * shard_bytes_ / _min_bw(axes, machine)
+
+
+def compute_time(flops: float, hbm_bytes: float, machine: MachineSpec,
+                 degree: float = 1, bytes_predivided: bool = False) -> float:
+    """Roofline on one chip for 1/degree of the work; fwd+bwd ≈ 3x fwd flops
+    (reference simulator models fwd and bwd tasks separately; the 3x is the
+    standard dense-training ratio). When bytes_predivided, hbm_bytes is
+    already the per-device traffic."""
+    d = max(1.0, degree)
+    eff_flops = machine.flops / machine.mxu_flop_overhead
+    t_flop = 3.0 * flops / d / eff_flops
+    t_mem = 2.0 * hbm_bytes / (1.0 if bytes_predivided else d) / machine.hbm_bw
+    return max(t_flop, t_mem)
+
+
+def reshard_time(spec: TensorSpec, src: Sequence[DimSharding],
+                 dst: Sequence[DimSharding], machine: MachineSpec) -> float:
+    """Cost of moving a tensor from layout src to dst — the price of a
+    parallel op (Repartition/Combine/Replicate/AllToAll) on this machine."""
+    nd = spec.ndim
+    src = list(src or [None] * nd) + [None] * (nd - len(src or []))
+    dst = list(dst or [None] * nd) + [None] * (nd - len(dst or []))
+    if [_axes_of(a) for a in src] == [_axes_of(a) for a in dst]:
+        return 0.0
+    t = 0.0
+    moved_axes = set()
+    src_all = {a for d in src for a in _axes_of(d)}
+    dst_all = {a for d in dst for a in _axes_of(d)}
+    for i in range(nd):
+        sa, da = set(_axes_of(src[i])), set(_axes_of(dst[i]))
+        # axis moved to a different dim → all_to_all over that axis
+        for a in sa - da:
+            if a in dst_all:
+                t += all_to_all_time(shard_bytes(spec, src, machine), (a,), machine)
+                moved_axes.add(a)
+    # axes fully removed (not present anywhere in dst) → all_gather
+    gone = src_all - dst_all - moved_axes
+    if gone:
+        t += all_gather_time(spec.size_bytes / max(1, dims_degree(
+            [None if set(_axes_of(d)) <= gone else d for d in src], machine)),
+            tuple(gone), machine)
+    # axes newly added where tensor was replicated → local slice (free)
+    return t
+
+
+def grad_sync_time(weight_specs: Dict[str, TensorSpec],
+                   weight_dims: Dict[str, List[DimSharding]],
+                   machine: MachineSpec, batch_axes: Sequence[str]) -> float:
+    """Gradient all-reduce over the replica axes of each weight (reference:
+    ncclAllReduce fused into the optimizer update, optimizer_kernel.cu:88)."""
+    t = 0.0
+    for w, spec in weight_specs.items():
+        dims = weight_dims.get(w, [None] * spec.ndim)
+        used = {a for d in dims for a in _axes_of(d)}
+        replica_axes = tuple(a for a in batch_axes if a not in used)
+        if replica_axes:
+            t += all_reduce_time(shard_bytes(spec, dims, machine), replica_axes, machine)
+    return t

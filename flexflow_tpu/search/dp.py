@@ -1,0 +1,134 @@
+"""Frontier dynamic program with beam pruning.
+
+Reference analog: `SearchHelper::graph_cost<T>` (src/runtime/graph.cc:1586)
+— Unity's memoized DP that splits the PCG at post-dominators (sequence
+splits) and over machine resources (nonsequence splits). The TPU formulation
+exploits the same structure differently: processing layers in topological
+order, the DP state is the layout assignment of the **live frontier**
+(tensors still awaited by a future consumer). On a chain the frontier is one
+tensor and the DP is exact — exactly the reference's sequence split; at joins
+(residual connections) the frontier carries both tensors, which prices the
+branch interaction exactly rather than approximating it. Beam pruning bounds
+the state count on wide graphs (DLRM's 26-table concat), playing the role of
+the reference's best-first budget (substitution.cc:2229-2311).
+
+Memory is tracked per state and a quadratic penalty applies beyond the HBM
+budget (the memory-aware lambda search analog, graph.cc:2046-2160).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from flexflow_tpu.core.graph import topo_order
+from flexflow_tpu.parallel.machine import MachineSpec
+from flexflow_tpu.search import cost_model as cm
+from flexflow_tpu.search.candidates import Candidate, layer_candidates
+
+
+def _freeze_dims(dims) -> Tuple:
+    out = []
+    for d in dims or ():
+        if d is None:
+            out.append(None)
+        elif isinstance(d, str):
+            out.append(d)
+        else:
+            out.append(tuple(d))
+    return tuple(out)
+
+
+def _score(cost: float, mem: int, mem_budget: float) -> float:
+    """Cost + quadratic over-HBM penalty (memory-aware lambda analog)."""
+    if mem <= mem_budget:
+        return cost
+    over = (mem - mem_budget) / mem_budget
+    return cost + 10.0 * over * over
+
+
+@dataclasses.dataclass
+class SearchResult:
+    choices: Dict[str, Candidate]  # layer name -> chosen candidate
+    cost: float                    # predicted step time (s)
+    mem_bytes: int                 # predicted per-device memory high-water
+
+
+def search_graph(model, machine: MachineSpec, beam_width: int = 64,
+                 enable_parameter: bool = True, enable_attribute: bool = True,
+                 mem_budget: Optional[float] = None,
+                 cost_fn=None) -> SearchResult:
+    """cost_fn(layer, cand) -> seconds overrides the analytic op time
+    (hook for the measured path, search/measure.py)."""
+    layers = topo_order(model.layers)
+    batch_sizes = {t.shape[0] for t in model.input_tensors if t.ndim > 0}
+    mem_budget = mem_budget or machine.hbm_bytes
+
+    # liveness: tensor guid -> index of last consuming layer
+    last_use: Dict[int, int] = {}
+    for li, layer in enumerate(layers):
+        for t in layer.inputs:
+            last_use[t.guid] = li
+
+    # initial frontier: graph inputs, data-parallel layout
+    from flexflow_tpu.search.candidates import _dp_dims
+
+    init_frontier = tuple(sorted(
+        (t.guid, _freeze_dims(_dp_dims(t.shape, machine, batch_sizes)))
+        for t in model.input_tensors))
+    # beam entries: frontier -> (cost, mem, trace)  trace = tuple of cand names
+    beam: Dict[Tuple, Tuple[float, int, Tuple]] = {init_frontier: (0.0, 0, ())}
+    specs = {t.guid: t.spec for t in model.input_tensors}
+    cand_cache: Dict[str, List[Candidate]] = {}
+
+    for li, layer in enumerate(layers):
+        for o in layer.outputs:
+            specs[o.guid] = o.spec
+        cands = layer_candidates(layer, machine, batch_sizes,
+                                 enable_parameter, enable_attribute)
+        cand_cache[layer.name] = cands
+        new_beam: Dict[Tuple, Tuple[float, int, Tuple]] = {}
+        for frontier, (cost, mem, trace) in beam.items():
+            fmap = dict(frontier)
+            for ci, cand in enumerate(cands):
+                c = cost
+                # edge costs: reshard each input from its frontier layout
+                feasible = True
+                for ii, tin in enumerate(layer.inputs):
+                    cur = fmap.get(tin.guid)
+                    if cur is None:
+                        feasible = False
+                        break
+                    want = _freeze_dims(cand.in_dims[ii] if ii < len(cand.in_dims)
+                                        else [None] * tin.spec.ndim)
+                    c += cm.reshard_time(tin.spec, list(cur), list(want), machine)
+                if not feasible:
+                    continue
+                c += cost_fn(layer, cand) if cost_fn else cand.op_time(layer, machine)
+                m = mem + cand.mem_bytes(layer, machine)
+                # new frontier: drop dead tensors, add outputs
+                nf = {g: d for g, d in fmap.items()
+                      if last_use.get(g, -1) > li}
+                for oi, o in enumerate(layer.outputs):
+                    if last_use.get(o.guid, -1) > li or layer is layers[-1]:
+                        nf[o.guid] = _freeze_dims(
+                            cand.out_dims[oi] if oi < len(cand.out_dims)
+                            else [None] * o.spec.ndim)
+                key = tuple(sorted(nf.items()))
+                prev = new_beam.get(key)
+                if prev is None or _score(c, m, mem_budget) < _score(prev[0], prev[1], mem_budget):
+                    new_beam[key] = (c, m, trace + (ci,))
+        # beam prune (ranked by cost + memory penalty)
+        if len(new_beam) > beam_width:
+            ranked = sorted(new_beam.items(),
+                            key=lambda kv: _score(kv[1][0], kv[1][1], mem_budget))
+            new_beam = dict(ranked[:beam_width])
+        beam = new_beam
+        if not beam:
+            raise RuntimeError(f"search dead-ended at layer {layer.name}")
+
+    best_frontier, (best_cost, best_mem, best_trace) = min(
+        beam.items(), key=lambda kv: _score(kv[1][0], kv[1][1], mem_budget))
+    choices = {layer.name: cand_cache[layer.name][ci]
+               for layer, ci in zip(layers, best_trace)}
+    return SearchResult(choices=choices, cost=best_cost, mem_bytes=best_mem)

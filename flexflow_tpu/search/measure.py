@@ -1,0 +1,101 @@
+"""Measured per-op costs — the on-device microbenchmark path.
+
+Reference analog: `Op::inner_measure_operator_cost` (src/runtime/model.cu:
+38-74): run the op's kernels on a real device with warmup + repeats under
+cudaEvent timing, cached by (op params, machine view)
+(Simulator::measure_operator_cost, src/runtime/simulator.cc:537-560).
+
+TPU version: jit the op's lowering at **shard-local shapes** for the
+candidate's layout on one real chip, block_until_ready-time it, and cache by
+(params_key, layout). The known fidelity limit (SURVEY.md §7 hard part #1):
+XLA fuses across ops, so isolated measurements over-predict; the analytic
+model is the default and this path is opt-in calibration.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import TYPE_CHECKING, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+if TYPE_CHECKING:
+    from flexflow_tpu.core.layer import Layer
+    from flexflow_tpu.search.candidates import Candidate
+
+from flexflow_tpu.ops.registry import LoweringCtx, get_op_def
+from flexflow_tpu.parallel.machine import MachineSpec
+from flexflow_tpu.search import cost_model as cm
+
+
+def _shard_shape(shape, dims, machine):
+    out = []
+    for i, s in enumerate(shape):
+        d = dims[i] if dims and i < len(dims) else None
+        axes = () if d is None else ((d,) if isinstance(d, str) else tuple(d))
+        deg = 1
+        for a in axes:
+            deg *= machine.mesh_axes.get(a, 1)
+        out.append(max(1, s // max(1, deg)))
+    return tuple(out)
+
+
+class MeasuredCost:
+    def __init__(self, machine: MachineSpec, repeats: int = 5, warmup: int = 2):
+        self.machine = machine
+        self.repeats = repeats
+        self.warmup = warmup
+        self.cache: Dict[Tuple, float] = {}
+
+    def op_time(self, layer: "Layer", cand: "Candidate") -> float:
+        key = (layer.params_key(),
+               tuple(tuple(map(str, d)) for d in cand.out_dims),
+               tuple(sorted((w, tuple(map(str, d))) for w, d in cand.weight_dims.items())))
+        if key in self.cache:
+            return self.cache[key]
+        try:
+            t = self._measure(layer, cand)
+        except Exception:
+            t = cand.op_time(layer, self.machine)  # fall back to analytic
+        self.cache[key] = t
+        return t
+
+    def _measure(self, layer: "Layer", cand: "Candidate") -> float:
+        machine = self.machine
+        rng = np.random.default_rng(0)
+        ins = []
+        for i, tin in enumerate(layer.inputs):
+            shp = _shard_shape(tin.spec.shape, cand.in_dims[i] if i < len(cand.in_dims) else None, machine)
+            dt = tin.spec.dtype.jnp_dtype
+            if jnp.issubdtype(dt, jnp.integer):
+                ins.append(jnp.asarray(rng.integers(0, 2, size=shp), dt))
+            else:
+                ins.append(jnp.asarray(rng.normal(size=shp), dt))
+        weights = {}
+        for w, spec in layer.weight_specs.items():
+            shp = _shard_shape(spec.shape, cand.weight_dims.get(w), machine)
+            weights[w] = jnp.asarray(rng.normal(size=shp), spec.dtype.jnp_dtype)
+
+        lower = get_op_def(layer.op_type).lower
+
+        @jax.jit
+        def run(ins, weights):
+            ctx = LoweringCtx(training=False, rng=jax.random.PRNGKey(0))
+            return lower(layer, ins, weights, ctx)
+
+        out = run(ins, weights)
+        jax.block_until_ready(out)
+        for _ in range(self.warmup):
+            jax.block_until_ready(run(ins, weights))
+        t0 = time.perf_counter()
+        for _ in range(self.repeats):
+            out = run(ins, weights)
+        jax.block_until_ready(out)
+        fwd = (time.perf_counter() - t0) / self.repeats
+        # fwd+bwd ≈ 3x fwd; add the candidate's inherent collectives + grad sync
+        from flexflow_tpu.search.candidates import _batch_axes
+
+        return 3.0 * fwd + cand.extra_comm + cm.grad_sync_time(
+            layer.weight_specs, cand.weight_dims, machine, _batch_axes(machine))

@@ -1,0 +1,61 @@
+"""graph_optimize — the search entry point.
+
+Reference analog: `Graph::graph_optimize_task` →
+`GraphSearchHelper::graph_optimize` (src/runtime/substitution.cc:1898-1945):
+construct PCG, search, serialize strategy. Here: candidates + frontier DP →
+Strategy (the per-op PartitionSpec map). The search budget scales the beam
+width (the best-first budget analog); alpha is accepted for interface parity.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from flexflow_tpu.core.graph import topo_order
+from flexflow_tpu.parallel.machine import MachineSpec
+from flexflow_tpu.parallel.sharding import OpSharding, Strategy
+from flexflow_tpu.search.candidates import _dp_dims
+from flexflow_tpu.search.dp import SearchResult, search_graph
+
+
+def result_to_strategy(model, machine: MachineSpec, result: SearchResult) -> Strategy:
+    st = Strategy(mesh_axes=dict(machine.mesh_axes), name="searched")
+    batch_sizes = {t.shape[0] for t in model.input_tensors if t.ndim > 0}
+    for t in model.input_tensors:
+        st.input_shardings[t.name] = _dp_dims(t.shape, machine, batch_sizes)
+    for layer in topo_order(model.layers):
+        cand = result.choices[layer.name]
+        st.op_shardings[layer.name] = OpSharding(
+            outputs=[list(d) for d in cand.out_dims],
+            weights={w: list(d) for w, d in cand.weight_dims.items()},
+        )
+    return st
+
+
+def graph_optimize(model, machine: MachineSpec,
+                   measured: bool = False) -> Strategy:
+    cfg = model.config
+    beam = max(16, cfg.search_budget)
+    cost_fn = None
+    if measured or cfg.profiling:
+        try:
+            from flexflow_tpu.search.measure import MeasuredCost
+
+            cost_fn = MeasuredCost(machine).op_time
+        except Exception:
+            cost_fn = None
+    result = search_graph(
+        model, machine, beam_width=beam,
+        enable_parameter=cfg.enable_parameter_parallel and not cfg.only_data_parallel,
+        enable_attribute=cfg.enable_attribute_parallel and not cfg.only_data_parallel,
+        mem_budget=machine.hbm_bytes if cfg.memory_search else None,
+        cost_fn=cost_fn,
+    )
+    st = result_to_strategy(model, machine, result)
+    st.name = f"searched(cost={result.cost * 1e3:.3f}ms, mem={result.mem_bytes / 1e9:.2f}GB)"
+    return st
+
+
+def predict_step_time(model, machine: MachineSpec, beam_width: int = 64) -> float:
+    """Predicted per-step time of the best found strategy (simulator query)."""
+    return search_graph(model, machine, beam_width=beam_width).cost

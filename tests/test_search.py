@@ -1,0 +1,118 @@
+"""Search tier: cost model sanity + DP finds known-good strategies on small
+graphs (reference analog: brute-force-checkable optima, SURVEY.md §7 hard
+part #2)."""
+
+import numpy as np
+import pytest
+
+from flexflow_tpu import FFConfig, FFModel, LossType, SGDOptimizer
+from flexflow_tpu.core.tensor import TensorSpec
+from flexflow_tpu.parallel.machine import MachineSpec
+from flexflow_tpu.search import cost_model as cm
+from flexflow_tpu.search.dp import search_graph
+from flexflow_tpu.search.optimize import graph_optimize, result_to_strategy
+
+
+V5P8 = MachineSpec(mesh_axes={"data": 4, "model": 2}, chip="v5p")
+
+
+def test_collective_costs_monotone():
+    spec = TensorSpec((1024, 1024))
+    b = spec.size_bytes
+    ag2 = cm.all_gather_time(b, ("model",), V5P8)
+    ar2 = cm.all_reduce_time(b, ("model",), V5P8)
+    assert 0 < ag2 < ar2  # allreduce ~ 2x allgather
+    assert cm.all_gather_time(b, ("data",), V5P8) > ag2  # 4-way > 2-way ratio (k-1)/k
+    assert cm.all_reduce_time(b, (), V5P8) == 0.0
+
+
+def test_reshard_time_cases():
+    spec = TensorSpec((256, 256))
+    # same layout: free
+    assert cm.reshard_time(spec, ["data", None], ["data", None], V5P8) == 0.0
+    # combine (drop axis) costs an all_gather
+    assert cm.reshard_time(spec, ["data", None], [None, None], V5P8) > 0
+    # partition from replicated: free slice
+    assert cm.reshard_time(spec, [None, None], ["data", None], V5P8) == 0.0
+    # all_to_all: axis moves dims
+    t = cm.reshard_time(spec, ["model", None], [None, "model"], V5P8)
+    assert t > 0
+
+
+def build_big_mlp(hidden=8192, batch=32):
+    """Small batch + huge hidden: TP should beat DP (grad allreduce of a
+    67M-param layer dwarfs the batch-32 compute)."""
+    m = FFModel(FFConfig(batch_size=batch))
+    x = m.create_tensor([batch, hidden], name="x")
+    h = m.dense(x, hidden, activation="gelu", name="up")
+    h = m.dense(h, hidden, name="down")
+    out = m.dense(h, 64, name="head")
+    return m
+
+
+def test_search_prefers_tp_for_wide_mlp():
+    m = build_big_mlp()
+    res = search_graph(m, V5P8, beam_width=64)
+    names = {ln: c.name for ln, c in res.choices.items()}
+    assert names["up"].startswith("tp_col"), names
+    assert names["down"].startswith("tp_"), names  # row or col chain both valid
+    # TP strategy must beat pure data-parallel on this workload
+    dp_only = search_graph(m, V5P8, beam_width=64, enable_parameter=False)
+    assert res.cost < dp_only.cost
+
+
+def test_search_prefers_dp_for_small_model():
+    """Big batch + small weights: DP should win (grad sync trivial)."""
+    m = FFModel(FFConfig(batch_size=4096))
+    x = m.create_tensor([4096, 64], name="x")
+    h = m.dense(x, 64, activation="relu", name="l1")
+    out = m.dense(h, 8, name="l2")
+    res = search_graph(m, V5P8, beam_width=64)
+    assert res.choices["l1"].name == "dp"
+    assert res.choices["l2"].name == "dp"
+
+
+def test_search_memory_pressure_forces_sharding():
+    """A model too big for one chip's HBM must shard weights."""
+    tiny = MachineSpec(mesh_axes={"data": 2, "model": 4}, chip="v5e",
+                       hbm_bytes=2e9)  # 2 GB budget
+    m = FFModel(FFConfig(batch_size=32))
+    x = m.create_tensor([32, 8192], name="x")
+    h = m.dense(x, 16384, activation="gelu", name="up")  # 8192x16384 f32 = 0.5GB; x4 = 2GB
+    h = m.dense(h, 8192, name="down")
+    res = search_graph(m, tiny, beam_width=64, mem_budget=tiny.hbm_bytes)
+    assert res.mem_bytes < 2.5e9
+    assert res.choices["up"].name != "dp"
+
+
+def test_end_to_end_searched_strategy_runs():
+    cfg = FFConfig(batch_size=32, mesh_shape={"data": 4, "model": 2},
+                   search_budget=16)
+    m = FFModel(cfg)
+    x = m.create_tensor([32, 512], name="x")
+    h = m.dense(x, 2048, activation="gelu", name="up")
+    h = m.dense(h, 512, name="down")
+    out = m.dense(h, 16, name="head")
+    cm_ = m.compile(SGDOptimizer(lr=0.01), LossType.SPARSE_CATEGORICAL_CROSSENTROPY)
+    assert cm_.strategy.name.startswith("searched")
+    xd = np.random.default_rng(0).normal(size=(128, 512)).astype(np.float32)
+    yd = np.random.default_rng(1).integers(0, 16, size=128).astype(np.int32)
+    hist = cm_.fit(xd, yd, verbose=False)
+    assert np.isfinite(hist[-1]["loss"])
+
+
+def test_transformer_block_search_runs():
+    cfg = FFConfig(batch_size=8)
+    m = FFModel(cfg)
+    d = 256
+    x = m.create_tensor([8, 16, d], name="x")
+    att = m.multihead_attention(x, x, x, d, 8, name="mha")
+    h = m.add(att, x)
+    h = m.layer_norm(h, name="ln1")
+    up = m.dense(h, 4 * d, activation="gelu", name="ffn_up")
+    down = m.dense(up, d, name="ffn_down")
+    h = m.add(down, h)
+    res = search_graph(m, V5P8, beam_width=64)
+    assert np.isfinite(res.cost) and res.cost > 0
+    st = result_to_strategy(m, V5P8, res)
+    assert "mha" in st.op_shardings
