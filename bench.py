@@ -1,0 +1,89 @@
+"""Benchmark: GPT-2 training throughput on the available TPU chip(s).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Metric: samples/sec/chip training GPT-2 (BASELINE.md north star). vs_baseline
+is measured throughput relative to a hand-tuned reference estimate: 40% MFU
+(a strong expert-tuned single-chip GPT-2 training baseline) at the chip's
+bf16 peak — i.e. vs_baseline >= 1.0 means we beat the expert anchor.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    from flexflow_tpu import AdamOptimizer, FFConfig, FFModel
+    from flexflow_tpu.models import GPT2Config, build_gpt2
+    from flexflow_tpu.parallel.machine import MachineSpec
+
+    machine = MachineSpec.detect()
+    on_cpu = jax.devices()[0].platform == "cpu"
+
+    # single-chip GPT-2 benchmark config: small model, seq 512
+    cfg = GPT2Config(vocab=50257, seq=512, d_model=768, heads=12,
+                     layers=12, dropout=0.0)
+    batch = 8
+    if on_cpu:  # CI / no-TPU fallback keeps runtime sane
+        cfg = GPT2Config.tiny(seq=128)
+        batch = 4
+
+    ff_cfg = FFConfig(batch_size=batch, only_data_parallel=True,
+                      compute_dtype="bfloat16")
+    model = FFModel(ff_cfg)
+    (ids_t, pos_t), _ = build_gpt2(model, cfg, batch=batch)
+    cm = model.compile(AdamOptimizer(alpha=1e-4),
+                       loss_type="sparse_categorical_crossentropy", metrics=[])
+    cm.init(seed=0)
+
+    rng = np.random.default_rng(0)
+    ids = jax.device_put(rng.integers(0, cfg.vocab, size=(batch, cfg.seq)).astype(np.int32))
+    pos = jax.device_put(np.tile(np.arange(cfg.seq, dtype=np.int32), (batch, 1)))
+    labels = jax.device_put(rng.integers(0, cfg.vocab, size=(batch, cfg.seq)).astype(np.int32))
+    key = jax.random.PRNGKey(0)
+
+    def step():
+        nonlocal key
+        key = jax.random.fold_in(key, 1)
+        (cm.params, cm.opt_state, cm.state, loss, _) = cm.train_step(
+            cm.params, cm.opt_state, cm.state, [ids, pos], labels, key)
+        return loss
+
+    # warmup (compile)
+    loss = step()
+    jax.block_until_ready(loss)
+    for _ in range(2):
+        loss = step()
+    jax.block_until_ready(loss)
+
+    iters = 3 if on_cpu else 20
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = step()
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+    sps = iters * batch / dt
+
+    n_chips = max(1, len(jax.devices()))
+    sps_chip = sps / n_chips
+
+    # expert anchor: 40% MFU at chip bf16 peak
+    flops_per_sample = cfg.flops_per_token() * cfg.seq
+    ref_sps = 0.40 * machine.flops / flops_per_sample
+    print(json.dumps({
+        "metric": "gpt2_train_samples_per_sec_per_chip",
+        "value": round(sps_chip, 3),
+        "unit": "samples/s/chip",
+        "vs_baseline": round(sps_chip / ref_sps, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

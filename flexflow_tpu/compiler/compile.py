@@ -115,7 +115,8 @@ class CompiledModel:
         self.recompile_state = None  # set via recompile_on_condition
 
         self.forward_fn = build_forward(model.layers, model.input_tensors, outputs,
-                                        mesh, strategy)
+                                        mesh, strategy,
+                                        compute_dtype=self.cfg.compute_dtype)
         self._build_steps()
         self.params = None
         self.state: Dict[str, Any] = {}
@@ -303,7 +304,8 @@ class CompiledModel:
         if trigger(self):
             alter(self)
             self.forward_fn = build_forward(self.model.layers, self.model.input_tensors,
-                                            self.outputs, self.mesh, self.strategy)
+                                            self.outputs, self.mesh, self.strategy,
+                                            compute_dtype=self.cfg.compute_dtype)
             self._build_steps()
 
     # ------------------------------------------------------------- weights

@@ -56,16 +56,25 @@ def build_forward(
     mesh: Optional[Mesh],
     strategy: Strategy,
     seq_length: Optional[int] = None,
+    compute_dtype: Optional[str] = None,
 ) -> Callable:
     """Returns forward(params, state, input_arrays, training, rng)
     -> (output_arrays, new_state)."""
+    import jax.numpy as jnp
+
     order = topo_order(layers)
+    cast_to = None
+    if compute_dtype and compute_dtype not in ("float32", "f32", None):
+        cast_to = jnp.dtype(compute_dtype)
 
     def forward(params, state, input_arrays, training, rng):
         ctx = LoweringCtx(training=training, rng=rng, seq_length=seq_length,
-                          state=dict(state))
+                          state=dict(state),
+                          compute_dtype=str(cast_to) if cast_to else None)
         env: Dict[int, jax.Array] = {}
         for t, arr in zip(graph_inputs, input_arrays):
+            if cast_to is not None and jnp.issubdtype(arr.dtype, jnp.floating):
+                arr = arr.astype(cast_to)
             if mesh is not None:
                 arr = maybe_constrain(arr, strategy.input_pspec(t.name), mesh)
             env[t.guid] = arr

@@ -1,0 +1,41 @@
+"""AlexNet (reference: examples/cpp/AlexNet/alexnet.cc:36-60, bootcamp_demo
+keras CNN). CIFAR-10 variant by default (config #1 of BASELINE.md)."""
+
+from __future__ import annotations
+
+from flexflow_tpu.core.model import FFModel
+
+
+def build_alexnet(model: FFModel, batch: int = 64, in_hw: int = 224,
+                  channels: int = 3, classes: int = 1000):
+    x = model.create_tensor([batch, channels, in_hw, in_hw], name="image")
+    t = model.conv2d(x, 64, 11, 11, 4, 4, 2, 2, activation="relu", name="conv1")
+    t = model.pool2d(t, 3, 3, 2, 2, name="pool1")
+    t = model.conv2d(t, 192, 5, 5, 1, 1, 2, 2, activation="relu", name="conv2")
+    t = model.pool2d(t, 3, 3, 2, 2, name="pool2")
+    t = model.conv2d(t, 384, 3, 3, 1, 1, 1, 1, activation="relu", name="conv3")
+    t = model.conv2d(t, 256, 3, 3, 1, 1, 1, 1, activation="relu", name="conv4")
+    t = model.conv2d(t, 256, 3, 3, 1, 1, 1, 1, activation="relu", name="conv5")
+    t = model.pool2d(t, 3, 3, 2, 2, name="pool5")
+    t = model.flat(t)
+    t = model.dense(t, 4096, activation="relu", name="fc6")
+    t = model.dropout(t, 0.5)
+    t = model.dense(t, 4096, activation="relu", name="fc7")
+    t = model.dropout(t, 0.5)
+    out = model.dense(t, classes, name="fc8")
+    return x, out
+
+
+def build_alexnet_cifar10(model: FFModel, batch: int = 64):
+    """The bootcamp CIFAR-10 CNN (reference: bootcamp_demo/keras_cnn_cifar10.py)."""
+    x = model.create_tensor([batch, 3, 32, 32], name="image")
+    t = model.conv2d(x, 32, 3, 3, 1, 1, 1, 1, activation="relu", name="conv1")
+    t = model.conv2d(t, 32, 3, 3, 1, 1, 1, 1, activation="relu", name="conv2")
+    t = model.pool2d(t, 2, 2, 2, 2, name="pool1")
+    t = model.conv2d(t, 64, 3, 3, 1, 1, 1, 1, activation="relu", name="conv3")
+    t = model.conv2d(t, 64, 3, 3, 1, 1, 1, 1, activation="relu", name="conv4")
+    t = model.pool2d(t, 2, 2, 2, 2, name="pool2")
+    t = model.flat(t)
+    t = model.dense(t, 512, activation="relu", name="fc1")
+    out = model.dense(t, 10, name="fc2")
+    return x, out

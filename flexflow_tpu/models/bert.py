@@ -1,0 +1,23 @@
+"""BERT encoder (config #3 of BASELINE.md: BERT-base pretraining proxy,
+reference: examples/python/native/bert_proxy_native.py — encoder stack at
+BERT-base dims driven by synthetic data)."""
+
+from __future__ import annotations
+
+from flexflow_tpu.core.model import FFModel
+from flexflow_tpu.dtype import DataType
+from flexflow_tpu.models.transformer import transformer_block
+
+
+def build_bert(model: FFModel, batch: int = 8, seq: int = 512,
+               vocab: int = 30522, d_model: int = 768, heads: int = 12,
+               layers: int = 12, d_ff: int = 3072):
+    ids = model.create_tensor([batch, seq], DataType.INT32, name="input_ids")
+    pos = model.create_tensor([batch, seq], DataType.INT32, name="position_ids")
+    tok = model.embedding(ids, vocab, d_model, name="tok_emb")
+    pe = model.embedding(pos, seq, d_model, name="pos_emb")
+    t = model.layer_norm(model.add(tok, pe), name="emb_ln")
+    for i in range(layers):
+        t = transformer_block(model, t, d_model, heads, d_ff, f"enc{i}")
+    logits = model.dense(t, vocab, name="mlm_head")
+    return (ids, pos), logits

@@ -1,0 +1,76 @@
+"""GPT-2 (config #5 of BASELINE.md: GPT-2 medium, the Unity OSDI'22
+pipeline+tensor-parallel workload; north-star model for the v5p target).
+
+Pre-LN decoder blocks with learned positional embeddings, causal attention,
+gelu FFN, weight-tied-free LM head (reference Transformer example has no
+embedding layer; GPT-2 here follows the standard architecture so torch/HF
+checkpoints map 1:1)."""
+
+from __future__ import annotations
+
+import dataclasses
+
+from flexflow_tpu.core.model import FFModel
+from flexflow_tpu.dtype import DataType
+
+
+@dataclasses.dataclass
+class GPT2Config:
+    vocab: int = 50257
+    seq: int = 1024
+    d_model: int = 768
+    heads: int = 12
+    layers: int = 12
+    d_ff: int = 0  # 0 -> 4*d_model
+    dropout: float = 0.1
+
+    @staticmethod
+    def small():
+        return GPT2Config()
+
+    @staticmethod
+    def medium():
+        return GPT2Config(d_model=1024, heads=16, layers=24)
+
+    @staticmethod
+    def tiny(seq: int = 128):
+        return GPT2Config(vocab=5120, seq=seq, d_model=256, heads=4, layers=2)
+
+    @property
+    def ff(self):
+        return self.d_ff or 4 * self.d_model
+
+    def flops_per_token(self) -> float:
+        """Approximate training FLOPs per token (6N + attention)."""
+        n_params = (self.vocab * self.d_model + self.seq * self.d_model
+                    + self.layers * (4 * self.d_model * self.d_model
+                                     + 2 * self.d_model * self.ff))
+        attn = self.layers * 2 * 2 * self.seq * self.d_model  # qk^T + av per token
+        return 6.0 * n_params + 3.0 * attn
+
+
+def gpt2_block(model: FFModel, t, cfg: GPT2Config, name: str):
+    h = model.layer_norm(t, name=f"{name}_ln1")
+    att = model.multihead_attention(h, h, h, cfg.d_model, cfg.heads,
+                                    dropout=cfg.dropout, causal=True,
+                                    name=f"{name}_attn")
+    t = model.add(att, t, name=f"{name}_res1")
+    h = model.layer_norm(t, name=f"{name}_ln2")
+    up = model.dense(h, cfg.ff, activation="gelu", name=f"{name}_mlp_up")
+    down = model.dense(up, cfg.d_model, name=f"{name}_mlp_down")
+    return model.add(down, t, name=f"{name}_res2")
+
+
+def build_gpt2(model: FFModel, cfg: GPT2Config, batch: int = 8):
+    ids = model.create_tensor([batch, cfg.seq], DataType.INT32, name="input_ids")
+    pos = model.create_tensor([batch, cfg.seq], DataType.INT32, name="position_ids")
+    tok = model.embedding(ids, cfg.vocab, cfg.d_model, name="wte")
+    pe = model.embedding(pos, cfg.seq, cfg.d_model, name="wpe")
+    t = model.add(tok, pe, name="embed_add")
+    if cfg.dropout:
+        t = model.dropout(t, cfg.dropout, name="embed_drop")
+    for i in range(cfg.layers):
+        t = gpt2_block(model, t, cfg, f"h{i}")
+    t = model.layer_norm(t, name="ln_f")
+    logits = model.dense(t, cfg.vocab, use_bias=False, name="lm_head")
+    return (ids, pos), logits

@@ -1,0 +1,18 @@
+"""MLP (reference: examples/cpp/MLP_Unify/mlp.cc, examples/python/native/
+mnist_mlp.py)."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from flexflow_tpu.core.model import FFModel
+
+
+def build_mlp(model: FFModel, batch: int, in_dim: int,
+              hidden: Sequence[int] = (512, 512), classes: int = 10):
+    x = model.create_tensor([batch, in_dim], name="x")
+    h = x
+    for i, hdim in enumerate(hidden):
+        h = model.dense(h, hdim, activation="relu", name=f"mlp_h{i}")
+    out = model.dense(h, classes, name="mlp_out")
+    return x, out

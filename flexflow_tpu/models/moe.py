@@ -1,0 +1,17 @@
+"""Mixture-of-experts MLP (reference: examples/cpp/mixture_of_experts/
+moe.cc:100-130 — MNIST-scale MoE with topk gating over 128 experts)."""
+
+from __future__ import annotations
+
+from flexflow_tpu.core.model import FFModel
+
+
+def build_moe_mlp(model: FFModel, batch: int = 64, in_dim: int = 784,
+                  num_exp: int = 64, num_select: int = 2,
+                  hidden: int = 64, classes: int = 10, alpha: float = 2.0):
+    x = model.create_tensor([batch, in_dim], name="x")
+    t = model.dense(x, hidden, activation="relu", name="pre")
+    t = model.moe(t, num_exp=num_exp, num_select=num_select,
+                  expert_hidden_size=hidden, alpha=alpha, name="moe")
+    out = model.dense(t, classes, name="head")
+    return x, out

@@ -1,0 +1,32 @@
+"""Transformer encoder stack (reference: examples/cpp/Transformer/
+transformer.cc:18-60 — attention + 2-layer FFN blocks, the OSDI'22 BERT
+harness workload)."""
+
+from __future__ import annotations
+
+from flexflow_tpu.core.model import FFModel
+
+
+def transformer_block(model: FFModel, t, d_model: int, heads: int, d_ff: int,
+                      name: str, dropout: float = 0.1, causal: bool = False):
+    att = model.multihead_attention(t, t, t, d_model, heads, dropout=dropout,
+                                    causal=causal, name=f"{name}_mha")
+    t = model.layer_norm(model.add(att, t), name=f"{name}_ln1")
+    up = model.dense(t, d_ff, activation="relu", name=f"{name}_ffn_up")
+    down = model.dense(up, d_model, name=f"{name}_ffn_down")
+    return model.layer_norm(model.add(down, t), name=f"{name}_ln2")
+
+
+def build_transformer(model: FFModel, batch: int = 8, seq: int = 512,
+                      d_model: int = 512, heads: int = 8, d_ff: int = 2048,
+                      layers: int = 6, classes: int = 0):
+    """The reference example feeds raw (batch, seq, d_model) activations
+    (transformer.cc creates the input tensor directly); classes>0 appends an
+    LM head."""
+    x = model.create_tensor([batch, seq, d_model], name="x")
+    t = x
+    for i in range(layers):
+        t = transformer_block(model, t, d_model, heads, d_ff, f"blk{i}")
+    if classes:
+        t = model.dense(t, classes, name="lm_head")
+    return x, t

@@ -36,6 +36,9 @@ class LoweringCtx:
     training: bool = False
     rng: Optional[jax.Array] = None
     seq_length: Optional[int] = None  # FFIterationConfig.seq_length analog
+    # mixed-precision policy (reference: --allow-tensor-op-math-conversion,
+    # the cuDNN tensor-op analog → bf16 on the MXU). None = keep input dtypes.
+    compute_dtype: Optional[str] = None
     # non-trainable state (batch-norm running stats, cache scores):
     state: Dict[str, Any] = dataclasses.field(default_factory=dict)
     new_state: Dict[str, Any] = dataclasses.field(default_factory=dict)

@@ -1,0 +1,119 @@
+"""Model zoo smoke: every reference workload builds, shapes check, and a tiny
+variant runs a train step (reference analog: tests/multi_gpu_tests.sh)."""
+
+import numpy as np
+import pytest
+
+from flexflow_tpu import FFConfig, FFModel, LossType, MetricsType, SGDOptimizer
+from flexflow_tpu.models import (
+    GPT2Config,
+    build_alexnet,
+    build_bert,
+    build_dlrm,
+    build_gpt2,
+    build_inception_v3,
+    build_moe_mlp,
+    build_resnet50,
+    build_transformer,
+)
+from flexflow_tpu.models.alexnet import build_alexnet_cifar10
+
+
+def test_alexnet_shapes():
+    m = FFModel(FFConfig(batch_size=8))
+    x, out = build_alexnet(m, batch=8)
+    assert out.shape == (8, 1000)
+
+
+def test_resnet50_shapes():
+    m = FFModel(FFConfig(batch_size=4))
+    x, out = build_resnet50(m, batch=4)
+    assert out.shape == (4, 1000)
+    assert len(m.layers) > 100
+
+
+def test_inception_shapes():
+    m = FFModel(FFConfig(batch_size=2))
+    x, out = build_inception_v3(m, batch=2)
+    assert out.shape == (2, 1000)
+
+
+def test_gpt2_shapes():
+    cfg = GPT2Config.tiny()
+    m = FFModel(FFConfig(batch_size=2))
+    ins, logits = build_gpt2(m, cfg, batch=2)
+    assert logits.shape == (2, cfg.seq, cfg.vocab)
+
+
+def test_bert_shapes():
+    m = FFModel(FFConfig(batch_size=2))
+    ins, logits = build_bert(m, batch=2, seq=32, vocab=1000, d_model=64,
+                             heads=4, layers=2, d_ff=128)
+    assert logits.shape == (2, 32, 1000)
+
+
+def test_dlrm_shapes():
+    m = FFModel(FFConfig(batch_size=16))
+    ins, out = build_dlrm(m, batch=16, embedding_tables=(1000,) * 4)
+    assert out.shape == (16, 1)
+    assert len(ins) == 5
+
+
+def test_alexnet_cifar10_trains():
+    m = FFModel(FFConfig(batch_size=16, epochs=1, only_data_parallel=True))
+    x, out = build_alexnet_cifar10(m, batch=16)
+    m.compile(SGDOptimizer(lr=0.01), LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+              [MetricsType.ACCURACY])
+    xd = np.random.default_rng(0).normal(size=(32, 3, 32, 32)).astype(np.float32)
+    yd = np.random.default_rng(1).integers(0, 10, size=32).astype(np.int32)
+    hist = m.fit(xd, yd, verbose=False)
+    assert np.isfinite(hist[0]["loss"])
+
+
+def test_gpt2_tiny_trains():
+    cfg = GPT2Config.tiny(seq=32)
+    m = FFModel(FFConfig(batch_size=4, epochs=1, only_data_parallel=True))
+    (ids, pos), logits = build_gpt2(m, cfg, batch=4)
+    cm = m.compile(SGDOptimizer(lr=0.01), LossType.SPARSE_CATEGORICAL_CROSSENTROPY)
+    rng = np.random.default_rng(0)
+    idd = rng.integers(0, cfg.vocab, size=(8, 32)).astype(np.int32)
+    posd = np.tile(np.arange(32, dtype=np.int32), (8, 1))
+    labels = rng.integers(0, cfg.vocab, size=(8, 32)).astype(np.int32)
+    hist = cm.fit([idd, posd], labels, verbose=False)
+    assert np.isfinite(hist[0]["loss"])
+
+
+def test_dlrm_trains():
+    m = FFModel(FFConfig(batch_size=16, epochs=1, only_data_parallel=True))
+    ins, out = build_dlrm(m, batch=16, embedding_tables=(500,) * 4)
+    cm = m.compile(SGDOptimizer(lr=0.01), LossType.MEAN_SQUARED_ERROR,
+                   [MetricsType.MEAN_SQUARED_ERROR])
+    rng = np.random.default_rng(0)
+    dense = rng.normal(size=(32, 13)).astype(np.float32)
+    sparse = [rng.integers(0, 500, size=(32, 1)).astype(np.int32) for _ in range(4)]
+    y = rng.random(size=(32, 1)).astype(np.float32)
+    hist = cm.fit([dense] + sparse, y, verbose=False)
+    assert np.isfinite(hist[0]["loss"])
+
+
+def test_moe_trains():
+    m = FFModel(FFConfig(batch_size=32, epochs=1, only_data_parallel=True))
+    x, out = build_moe_mlp(m, batch=32, in_dim=64, num_exp=8, hidden=32)
+    cm = m.compile(SGDOptimizer(lr=0.01), LossType.SPARSE_CATEGORICAL_CROSSENTROPY)
+    rng = np.random.default_rng(0)
+    xd = rng.normal(size=(64, 64)).astype(np.float32)
+    yd = rng.integers(0, 10, size=64).astype(np.int32)
+    hist = cm.fit(xd, yd, verbose=False)
+    assert np.isfinite(hist[0]["loss"])
+
+
+def test_resnet_search_runs():
+    """The searched path over a conv net with branches (exercises joins)."""
+    from flexflow_tpu.parallel.machine import MachineSpec
+    from flexflow_tpu.search.dp import search_graph
+
+    m = FFModel(FFConfig(batch_size=32))
+    x, out = build_resnet50(m, batch=32, in_hw=64, classes=100)
+    mach = MachineSpec(mesh_axes={"data": 4, "model": 2}, chip="v5p")
+    res = search_graph(m, mach, beam_width=16)
+    assert np.isfinite(res.cost) and res.cost > 0
