@@ -268,7 +268,13 @@ class CompiledModel:
         # last full batch are excluded (drop_remainder, like the reference's
         # shard-sized batches)
         xs = x if isinstance(x, (list, tuple)) else [x]
-        batch_size = self.model.input_tensors[0].shape[0]
+        gb = self.model.input_tensors[0].shape[0]
+        if batch_size is not None and batch_size != gb:
+            import warnings
+
+            warnings.warn(f"batch_size={batch_size} coerced to graph batch {gb} "
+                          "(XLA static shapes; rebuild the model to change it)")
+        batch_size = gb
         loader = SingleDataLoader(xs, y, batch_size, shuffle=False)
         in_sh = [self.input_sharding(t) for t in self.model.input_tensors]
         lab_sh = self.label_sharding((batch_size,) + tuple(np.asarray(y).shape[1:]))

@@ -78,9 +78,21 @@ def build_forward(
             if mesh is not None:
                 arr = maybe_constrain(arr, strategy.input_pspec(t.name), mesh)
             env[t.guid] = arr
+        from flexflow_tpu.ops.op_type import OperatorType
+
+        norm_types = (OperatorType.LAYERNORM, OperatorType.BATCHNORM)
         for layer in order:
             ins = [env[t.guid] for t in layer.inputs]
             w = params.get(layer.name, {})
+            if cast_to is not None and layer.op_type not in norm_types:
+                # uniform mixed-precision policy: master weights stay f32 in
+                # params/optimizer, every op computes in compute_dtype; grads
+                # flow back through the cast and accumulate in f32. Norm
+                # params (gamma/beta) are exempt — their lowerings compute the
+                # affine in f32 (standard AMP keeps norm params full precision).
+                w = {k: (v.astype(cast_to)
+                         if jnp.issubdtype(v.dtype, jnp.floating) else v)
+                     for k, v in w.items()}
             outs = get_op_def(layer.op_type).lower(layer, ins, w, ctx)
             if mesh is not None:
                 sh = strategy.sharding_for(layer.name)

@@ -21,4 +21,5 @@ from flexflow_tpu.ops import (  # noqa: F401
     embed_ops,
     attention_ops,
     moe_ops,
+    parallel_ops,
 )

@@ -110,7 +110,14 @@ def _mha_lower(layer: Layer, inputs, weights, ctx: LoweringCtx):
         logits = jnp.einsum("bqhd,bkhd->bhqk", qh, kh) * scale
         if causal:
             sq, sk = logits.shape[-2], logits.shape[-1]
-            mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+            # causal band over the ORIGINAL key positions only; positions
+            # appended by add_bias_kv/add_zero_attn (indices >= sk_orig, at the
+            # end) are always attendable and must not shift the band
+            sk_orig = k.shape[1]
+            mask = jnp.tril(jnp.ones((sq, sk_orig), bool), k=sk_orig - sq)
+            if sk > sk_orig:
+                mask = jnp.concatenate(
+                    [mask, jnp.ones((sq, sk - sk_orig), bool)], axis=1)
             logits = jnp.where(mask, logits, jnp.finfo(logits.dtype).min)
         probs = jax.nn.softmax(logits, axis=-1)
         if ctx.training and p.get("dropout", 0.0) > 0.0:

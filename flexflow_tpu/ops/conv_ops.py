@@ -132,21 +132,26 @@ def _bn_lower(layer: Layer, inputs, weights, ctx: LoweringCtx):
     bshape = [1] * x.ndim
     bshape[1] = x.shape[1]
     mean_key, var_key = f"{layer.name}/mean", f"{layer.name}/var"
+    # statistics + running stats in f32 (cuDNN BN accumulates f32 too);
+    # output returns to the activation dtype
+    xf = x.astype(jnp.float32)
     if ctx.training:
-        mean = jnp.mean(x, axis=axes)
-        var = jnp.var(x, axis=axes)
+        mean = jnp.mean(xf, axis=axes)
+        var = jnp.var(xf, axis=axes)
         rm = ctx.state.get(mean_key, jnp.zeros_like(mean))
         rv = ctx.state.get(var_key, jnp.ones_like(var))
         ctx.new_state[mean_key] = momentum * rm + (1 - momentum) * mean
         ctx.new_state[var_key] = momentum * rv + (1 - momentum) * var
     else:
-        mean = ctx.state.get(mean_key, jnp.zeros((x.shape[1],), x.dtype))
-        var = ctx.state.get(var_key, jnp.ones((x.shape[1],), x.dtype))
-    y = (x - mean.reshape(bshape)) * lax.rsqrt(var.reshape(bshape) + eps)
-    y = y * weights["gamma"].reshape(bshape) + weights["beta"].reshape(bshape)
+        mean = ctx.state.get(mean_key, jnp.zeros((x.shape[1],), jnp.float32))
+        var = ctx.state.get(var_key, jnp.ones((x.shape[1],), jnp.float32))
+    y = (xf - mean.astype(jnp.float32).reshape(bshape)) * lax.rsqrt(
+        var.astype(jnp.float32).reshape(bshape) + eps)
+    y = (y * weights["gamma"].astype(jnp.float32).reshape(bshape)
+         + weights["beta"].astype(jnp.float32).reshape(bshape))
     if layer.params.get("relu", False):
         y = jax.nn.relu(y)
-    return [y]
+    return [y.astype(x.dtype)]
 
 
 register_op(OperatorType.BATCHNORM, _bn_infer, _bn_lower)

@@ -33,9 +33,8 @@ def _emb_infer(layer: Layer):
 
 def _emb_lower(layer: Layer, inputs, weights, ctx):
     ids = inputs[0].astype(jnp.int32)
+    # table arrives pre-cast to compute_dtype by build_forward's uniform policy
     table = weights["kernel"]
-    if ctx.compute_dtype is not None:
-        table = table.astype(ctx.compute_dtype)
     aggr = layer.params.get("aggr", "none")
     y = jnp.take(table, ids, axis=0)
     if aggr == "sum":

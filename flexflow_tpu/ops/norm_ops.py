@@ -40,15 +40,18 @@ def _ln_lower(layer: Layer, inputs, weights, ctx):
     x = inputs[0]
     axes = layer.params["axes"]
     eps = layer.params.get("eps", 1e-5)
-    mean = jnp.mean(x, axis=axes, keepdims=True)
-    var = jnp.var(x, axis=axes, keepdims=True)
-    y = (x - mean) * lax.rsqrt(var + eps)
+    # statistics in f32 for bf16 stability; output back in the activation dtype
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.var(xf, axis=axes, keepdims=True)
+    y = (xf - mean) * lax.rsqrt(var + eps)
     if "gamma" in weights:
         bshape = [1] * x.ndim
         for a in axes:
             bshape[a] = x.shape[a]
-        y = y * weights["gamma"].reshape(bshape) + weights["beta"].reshape(bshape)
-    return [y]
+        y = (y * weights["gamma"].astype(jnp.float32).reshape(bshape)
+             + weights["beta"].astype(jnp.float32).reshape(bshape))
+    return [y.astype(x.dtype)]
 
 
 register_op(OperatorType.LAYERNORM, _ln_infer, _ln_lower)

@@ -65,13 +65,12 @@ class Candidate:
                                _batch_axes(machine))
         return t
 
-    def mem_bytes(self, layer: "Layer", machine: MachineSpec) -> int:
-        # per-device: weights x4 (param, grad, 2 opt moments) + activations x2
+    def weight_mem_bytes(self, layer: "Layer", machine: MachineSpec) -> int:
+        # per-device, persistent: weights x4 (param, grad, 2 opt moments);
+        # activation memory is tracked as a live set by the DP (search/dp.py)
         m = 0
         for w, spec in layer.weight_specs.items():
             m += 4 * cm.shard_bytes(spec, self.weight_dims.get(w, []), machine)
-        for i, o in enumerate(layer.outputs):
-            m += 2 * cm.shard_bytes(o.spec, self.out_dims[i], machine)
         return m
 
 

@@ -40,11 +40,12 @@ def _freeze_dims(dims) -> Tuple:
 
 
 def _score(cost: float, mem: int, mem_budget: float) -> float:
-    """Cost + quadratic over-HBM penalty (memory-aware lambda analog)."""
+    """Cost scaled by a quadratic over-HBM penalty (memory-aware lambda
+    analog). Multiplicative so the penalty has the same units as the cost."""
     if mem <= mem_budget:
         return cost
     over = (mem - mem_budget) / mem_budget
-    return cost + 10.0 * over * over
+    return cost * (1.0 + 10.0 * over * over)
 
 
 @dataclasses.dataclass
@@ -76,9 +77,19 @@ def search_graph(model, machine: MachineSpec, beam_width: int = 64,
     init_frontier = tuple(sorted(
         (t.guid, _freeze_dims(_dp_dims(t.shape, machine, batch_sizes)))
         for t in model.input_tensors))
-    # beam entries: frontier -> (cost, mem, trace)  trace = tuple of cand names
-    beam: Dict[Tuple, Tuple[float, int, Tuple]] = {init_frontier: (0.0, 0, ())}
     specs = {t.guid: t.spec for t in model.input_tensors}
+
+    def _live_act_bytes(frontier_map) -> int:
+        # 2x: forward value + gradient held for the backward pass
+        return sum(2 * cm.shard_bytes(specs[g], list(d), machine)
+                   for g, d in frontier_map.items())
+
+    # beam entries: frontier -> (cost, w_mem, high_water, trace)
+    # w_mem = cumulative persistent weight memory (params+grads+opt moments);
+    # high_water = max over layers of (w_mem + live activation bytes)
+    init_act = _live_act_bytes(dict(init_frontier))
+    beam: Dict[Tuple, Tuple[float, int, int, Tuple]] = {
+        init_frontier: (0.0, 0, init_act, ())}
     cand_cache: Dict[str, List[Candidate]] = {}
 
     for li, layer in enumerate(layers):
@@ -87,8 +98,8 @@ def search_graph(model, machine: MachineSpec, beam_width: int = 64,
         cands = layer_candidates(layer, machine, batch_sizes,
                                  enable_parameter, enable_attribute)
         cand_cache[layer.name] = cands
-        new_beam: Dict[Tuple, Tuple[float, int, Tuple]] = {}
-        for frontier, (cost, mem, trace) in beam.items():
+        new_beam: Dict[Tuple, Tuple[float, int, int, Tuple]] = {}
+        for frontier, (cost, w_mem, high, trace) in beam.items():
             fmap = dict(frontier)
             for ci, cand in enumerate(cands):
                 c = cost
@@ -105,30 +116,35 @@ def search_graph(model, machine: MachineSpec, beam_width: int = 64,
                 if not feasible:
                     continue
                 c += cost_fn(layer, cand) if cost_fn else cand.op_time(layer, machine)
-                m = mem + cand.mem_bytes(layer, machine)
+                wm = w_mem + cand.weight_mem_bytes(layer, machine)
+                out_dims = {
+                    o.guid: _freeze_dims(cand.out_dims[oi] if oi < len(cand.out_dims)
+                                         else [None] * o.spec.ndim)
+                    for oi, o in enumerate(layer.outputs)}
+                # peak while this layer runs: ALL its inputs (even those dying
+                # here) are live together with its outputs
+                hw = max(high, wm + _live_act_bytes({**fmap, **out_dims}))
                 # new frontier: drop dead tensors, add outputs
                 nf = {g: d for g, d in fmap.items()
                       if last_use.get(g, -1) > li}
-                for oi, o in enumerate(layer.outputs):
+                for o in layer.outputs:
                     if last_use.get(o.guid, -1) > li or layer is layers[-1]:
-                        nf[o.guid] = _freeze_dims(
-                            cand.out_dims[oi] if oi < len(cand.out_dims)
-                            else [None] * o.spec.ndim)
+                        nf[o.guid] = out_dims[o.guid]
                 key = tuple(sorted(nf.items()))
                 prev = new_beam.get(key)
-                if prev is None or _score(c, m, mem_budget) < _score(prev[0], prev[1], mem_budget):
-                    new_beam[key] = (c, m, trace + (ci,))
+                if prev is None or _score(c, hw, mem_budget) < _score(prev[0], prev[2], mem_budget):
+                    new_beam[key] = (c, wm, hw, trace + (ci,))
         # beam prune (ranked by cost + memory penalty)
         if len(new_beam) > beam_width:
             ranked = sorted(new_beam.items(),
-                            key=lambda kv: _score(kv[1][0], kv[1][1], mem_budget))
+                            key=lambda kv: _score(kv[1][0], kv[1][2], mem_budget))
             new_beam = dict(ranked[:beam_width])
         beam = new_beam
         if not beam:
             raise RuntimeError(f"search dead-ended at layer {layer.name}")
 
-    best_frontier, (best_cost, best_mem, best_trace) = min(
-        beam.items(), key=lambda kv: _score(kv[1][0], kv[1][1], mem_budget))
+    best_frontier, (best_cost, _, best_mem, best_trace) = min(
+        beam.items(), key=lambda kv: _score(kv[1][0], kv[1][2], mem_budget))
     choices = {layer.name: cand_cache[layer.name][ci]
                for layer, ci in zip(layers, best_trace)}
     return SearchResult(choices=choices, cost=best_cost, mem_bytes=best_mem)

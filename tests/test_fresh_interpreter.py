@@ -1,0 +1,48 @@
+"""Fresh-interpreter regression tests.
+
+Round-1 bug: ops/__init__.py did not import parallel_ops, so
+FFModel.repartition() raised in any process that had not already run
+compile() (registration happened only as an import side effect elsewhere).
+These tests run in a clean subprocess so import-order luck cannot mask
+registration gaps again.
+"""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_fresh(code: str):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=8")
+    return subprocess.run([sys.executable, "-c", code], cwd=REPO, env=env,
+                          capture_output=True, text=True, timeout=300)
+
+
+def test_parallel_ops_registered_in_fresh_process():
+    r = _run_fresh(
+        "from flexflow_tpu import FFConfig, FFModel\n"
+        "m = FFModel(FFConfig(batch_size=8, only_data_parallel=True))\n"
+        "x = m.create_tensor([8, 16], name='x')\n"
+        "p = m.repartition(x, dim=0, axis='data')\n"
+        "c = m.combine(p, dim=0, axis='data')\n"
+        "r = m.replicate(c)\n"
+        "d = m.reduction(r, axis='data')\n"
+        "print('ok', d.shape)\n")
+    assert r.returncode == 0, r.stderr
+    assert "ok" in r.stdout
+
+
+def test_all_op_builders_available_in_fresh_process():
+    r = _run_fresh(
+        "from flexflow_tpu.ops import has_op_def\n"
+        "from flexflow_tpu.ops.op_type import OperatorType, PARALLEL_OPS\n"
+        "missing = [t for t in PARALLEL_OPS if not has_op_def(t)]\n"
+        "assert not missing, missing\n"
+        "print('ok')\n")
+    assert r.returncode == 0, r.stderr
+    assert "ok" in r.stdout
