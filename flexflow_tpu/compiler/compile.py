@@ -215,6 +215,16 @@ class CompiledModel:
         self.eval_step = jax.jit(eval_step)
         self.infer_step = jax.jit(infer)
 
+    def _coerce_batch(self, batch_size: Optional[int]) -> int:
+        # batch must match the traced graph-input batch dim (XLA static shapes)
+        gb = self.model.input_tensors[0].shape[0]
+        if batch_size is not None and batch_size != gb:
+            import warnings
+
+            warnings.warn(f"batch_size={batch_size} coerced to graph batch {gb} "
+                          "(XLA static shapes; rebuild the model to change it)")
+        return gb
+
     # ------------------------------------------------------------- training
     def fit(self, x, y, batch_size: Optional[int] = None, epochs: Optional[int] = None,
             callbacks=None, verbose: bool = True):
@@ -223,14 +233,7 @@ class CompiledModel:
         epochs = epochs or self.cfg.epochs
         if self.params is None:
             self.init()
-        # batch must match the traced graph-input batch dim (static shapes)
-        gb = self.model.input_tensors[0].shape[0]
-        if batch_size != gb:
-            import warnings
-
-            warnings.warn(f"batch_size={batch_size} coerced to graph batch {gb} "
-                          "(XLA static shapes; rebuild the model to change it)")
-            batch_size = gb
+        batch_size = self._coerce_batch(batch_size)
         loader = SingleDataLoader(xs, y, batch_size, shuffle=True, seed=self.cfg.seed)
         in_sh = [self.input_sharding(t) for t in self.model.input_tensors]
         lab_sh = self.label_sharding((batch_size,) + tuple(np.asarray(y).shape[1:]))
@@ -268,13 +271,7 @@ class CompiledModel:
         # last full batch are excluded (drop_remainder, like the reference's
         # shard-sized batches)
         xs = x if isinstance(x, (list, tuple)) else [x]
-        gb = self.model.input_tensors[0].shape[0]
-        if batch_size is not None and batch_size != gb:
-            import warnings
-
-            warnings.warn(f"batch_size={batch_size} coerced to graph batch {gb} "
-                          "(XLA static shapes; rebuild the model to change it)")
-        batch_size = gb
+        batch_size = self._coerce_batch(batch_size)
         loader = SingleDataLoader(xs, y, batch_size, shuffle=False)
         in_sh = [self.input_sharding(t) for t in self.model.input_tensors]
         lab_sh = self.label_sharding((batch_size,) + tuple(np.asarray(y).shape[1:]))

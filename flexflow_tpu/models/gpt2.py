@@ -41,12 +41,22 @@ class GPT2Config:
         return self.d_ff or 4 * self.d_model
 
     def flops_per_token(self) -> float:
-        """Approximate training FLOPs per token (6N + attention)."""
-        n_params = (self.vocab * self.d_model + self.seq * self.d_model
-                    + self.layers * (4 * self.d_model * self.d_model
-                                     + 2 * self.d_model * self.ff))
-        attn = self.layers * 2 * 2 * self.seq * self.d_model  # qk^T + av per token
-        return 6.0 * n_params + 3.0 * attn
+        """Training (fwd + bwd) matmul FLOPs per token: 6 * N_matmul +
+        attention scores. Embedding lookups (wte/wpe) are gathers — zero
+        matmul FLOPs; the lm_head projection (d_model x vocab) IS a matmul
+        and is counted."""
+        n_matmul = (self.layers * (4 * self.d_model * self.d_model
+                                   + 2 * self.d_model * self.ff)
+                    + self.d_model * self.vocab)  # lm_head
+        attn = self.layers * 2 * 2 * self.seq * self.d_model  # qk^T + av, fwd
+        return 6.0 * n_matmul + 3.0 * attn
+
+    def param_count(self) -> int:
+        d = self.d_model
+        return (self.vocab * d + self.seq * d
+                + self.layers * (4 * d * d + 2 * d * self.ff
+                                 + 9 * d + self.ff)  # biases + 2 LN per block
+                + 2 * d + d * self.vocab)  # ln_f + lm_head
 
 
 def gpt2_block(model: FFModel, t, cfg: GPT2Config, name: str):

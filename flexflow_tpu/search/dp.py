@@ -41,11 +41,12 @@ def _freeze_dims(dims) -> Tuple:
 
 def _score(cost: float, mem: int, mem_budget: float) -> float:
     """Cost scaled by a quadratic over-HBM penalty (memory-aware lambda
-    analog). Multiplicative so the penalty has the same units as the cost."""
+    analog). Multiplicative so the penalty has the same units as the cost;
+    the small floor keeps the penalty alive even at zero accumulated cost."""
     if mem <= mem_budget:
         return cost
     over = (mem - mem_budget) / mem_budget
-    return cost * (1.0 + 10.0 * over * over)
+    return (cost + 1e-9) * (1.0 + 10.0 * over * over)
 
 
 @dataclasses.dataclass
@@ -84,9 +85,12 @@ def search_graph(model, machine: MachineSpec, beam_width: int = 64,
         return sum(2 * cm.shard_bytes(specs[g], list(d), machine)
                    for g, d in frontier_map.items())
 
-    # beam entries: frontier -> (cost, w_mem, high_water, trace)
-    # w_mem = cumulative persistent weight memory (params+grads+opt moments);
-    # high_water = max over layers of (w_mem + live activation bytes)
+    # beam entries: frontier -> (cost, w_mem, act_high, trace)
+    # w_mem = cumulative persistent weight memory (params+grads+opt moments:
+    # ALL of it is resident for the whole step, init allocates up front);
+    # act_high = max over layers of live activation bytes. The reported
+    # high-water is final_w_mem + act_high — weights from layers not yet
+    # processed are still counted against an early activation peak.
     init_act = _live_act_bytes(dict(init_frontier))
     beam: Dict[Tuple, Tuple[float, int, int, Tuple]] = {
         init_frontier: (0.0, 0, init_act, ())}
@@ -99,8 +103,9 @@ def search_graph(model, machine: MachineSpec, beam_width: int = 64,
                                  enable_parameter, enable_attribute)
         cand_cache[layer.name] = cands
         new_beam: Dict[Tuple, Tuple[float, int, int, Tuple]] = {}
-        for frontier, (cost, w_mem, high, trace) in beam.items():
+        for frontier, (cost, w_mem, act_high, trace) in beam.items():
             fmap = dict(frontier)
+            fmap_act = _live_act_bytes(fmap)
             for ci, cand in enumerate(cands):
                 c = cost
                 # edge costs: reshard each input from its frontier layout
@@ -122,8 +127,9 @@ def search_graph(model, machine: MachineSpec, beam_width: int = 64,
                                          else [None] * o.spec.ndim)
                     for oi, o in enumerate(layer.outputs)}
                 # peak while this layer runs: ALL its inputs (even those dying
-                # here) are live together with its outputs
-                hw = max(high, wm + _live_act_bytes({**fmap, **out_dims}))
+                # here) are live together with its outputs (out guids are new,
+                # so the two contributions are disjoint)
+                ah = max(act_high, fmap_act + _live_act_bytes(out_dims))
                 # new frontier: drop dead tensors, add outputs
                 nf = {g: d for g, d in fmap.items()
                       if last_use.get(g, -1) > li}
@@ -132,19 +138,22 @@ def search_graph(model, machine: MachineSpec, beam_width: int = 64,
                         nf[o.guid] = out_dims[o.guid]
                 key = tuple(sorted(nf.items()))
                 prev = new_beam.get(key)
-                if prev is None or _score(c, hw, mem_budget) < _score(prev[0], prev[2], mem_budget):
-                    new_beam[key] = (c, wm, hw, trace + (ci,))
-        # beam prune (ranked by cost + memory penalty)
+                if prev is None or _score(c, wm + ah, mem_budget) < _score(
+                        prev[0], prev[1] + prev[2], mem_budget):
+                    new_beam[key] = (c, wm, ah, trace + (ci,))
+        # beam prune (ranked by cost + memory penalty; wm+ah understates the
+        # final high-water by weights not yet placed, uniformly across states)
         if len(new_beam) > beam_width:
             ranked = sorted(new_beam.items(),
-                            key=lambda kv: _score(kv[1][0], kv[1][2], mem_budget))
+                            key=lambda kv: _score(kv[1][0], kv[1][1] + kv[1][2], mem_budget))
             new_beam = dict(ranked[:beam_width])
         beam = new_beam
         if not beam:
             raise RuntimeError(f"search dead-ended at layer {layer.name}")
 
-    best_frontier, (best_cost, _, best_mem, best_trace) = min(
-        beam.items(), key=lambda kv: _score(kv[1][0], kv[1][2], mem_budget))
+    best_frontier, (best_cost, best_wm, best_ah, best_trace) = min(
+        beam.items(), key=lambda kv: _score(kv[1][0], kv[1][1] + kv[1][2], mem_budget))
+    best_mem = best_wm + best_ah
     choices = {layer.name: cand_cache[layer.name][ci]
                for layer, ci in zip(layers, best_trace)}
     return SearchResult(choices=choices, cost=best_cost, mem_bytes=best_mem)

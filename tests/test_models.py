@@ -45,6 +45,16 @@ def test_gpt2_shapes():
     assert logits.shape == (2, cfg.seq, cfg.vocab)
 
 
+def test_gpt2_param_count_matches_built_model():
+    cfg = GPT2Config.tiny()
+    m = FFModel(FFConfig(batch_size=2))
+    build_gpt2(m, cfg, batch=2)
+    actual = sum(
+        int(np.prod(spec.shape))
+        for layer in m.layers for spec in layer.weight_specs.values())
+    assert actual == cfg.param_count(), (actual, cfg.param_count())
+
+
 def test_bert_shapes():
     m = FFModel(FFConfig(batch_size=2))
     ins, logits = build_bert(m, batch=2, seq=32, vocab=1000, d_model=64,
