@@ -262,6 +262,36 @@ class FFModel:
                                {"starts": tuple(starts), "limits": tuple(limits)},
                                [input], name)[0]
 
+    def expand(self, input, sizes: Sequence[int], name=None):
+        """torch.Tensor.expand semantics (-1 keeps the dim)."""
+        return self._add_layer(OperatorType.EXPAND, {"sizes": tuple(sizes)},
+                               [input], name)[0]
+
+    def constant(self, value, name=None) -> Tensor:
+        """A fixed array baked into the graph (torch registered buffers,
+        traced torch.tensor/ones/zeros literals)."""
+        return self._add_layer(OperatorType.CONSTANT,
+                               {"value": np.asarray(value)}, [], name)[0]
+
+    def masked_fill(self, input, mask: Tensor, value: float, name=None):
+        """Where mask is true, replace with value (torch.masked_fill)."""
+        return self._add_layer(OperatorType.MASKED_FILL, {"value": float(value)},
+                               [input, mask], name)[0]
+
+    def where(self, cond: Tensor, a: Tensor, b: Tensor, name=None):
+        """Elementwise select (torch.where): a where cond else b."""
+        return self._add_layer(OperatorType.WHERE, {}, [cond, a, b], name)[0]
+
+    def scaled_dot_product_attention(self, query, key, value, attn_mask=None,
+                                     dropout_p: float = 0.0, is_causal: bool = False,
+                                     scale=None, name=None) -> Tensor:
+        """Core attention without projections (torch F.scaled_dot_product_attention)."""
+        ins = [query, key, value] + ([attn_mask] if attn_mask is not None else [])
+        return self._add_layer(
+            OperatorType.SDPA,
+            {"dropout_p": dropout_p, "is_causal": is_causal, "scale": scale},
+            ins, name)[0]
+
     # reductions ----------------------------------------------------------
     def reduce_sum(self, input, axes, keepdims: bool = False, name=None):
         return self._add_layer(OperatorType.REDUCE_SUM,

@@ -145,3 +145,55 @@ def _mha_flops(layer: Layer):
 
 
 register_op(OperatorType.MULTIHEAD_ATTENTION, _mha_infer, _mha_lower, _mha_flops)
+
+
+def _sdpa_infer(layer: Layer):
+    """Core scaled-dot-product attention (torch.nn.functional.
+    scaled_dot_product_attention semantics): q (..., sq, d), k (..., sk, d),
+    v (..., sk, dv) -> (..., sq, dv). Optional 4th input: additive float mask
+    or boolean keep-mask, broadcastable to (..., sq, sk)."""
+    q, k, v = [t.spec for t in layer.inputs[:3]]
+    if q.shape[-1] != k.shape[-1]:
+        raise ValueError(f"q/k depth mismatch {q.shape} vs {k.shape}")
+    return [q.with_shape(q.shape[:-1] + (v.shape[-1],))]
+
+
+def _sdpa_lower(layer: Layer, inputs, weights, ctx: LoweringCtx):
+    q, k, v = inputs[:3]
+    mask = inputs[3] if len(inputs) > 3 else None
+    p = layer.params
+    scale = p.get("scale")
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    logits = jnp.einsum("...qd,...kd->...qk", q, k) * scale
+    neg = jnp.finfo(logits.dtype).min
+    if mask is not None:
+        if jnp.issubdtype(mask.dtype, jnp.bool_):
+            logits = jnp.where(mask, logits, neg)
+        else:
+            logits = logits + mask.astype(logits.dtype)
+    if p.get("is_causal", False):
+        # torch semantics: TOP-LEFT aligned causal band (tril diagonal=0),
+        # not bottom-right like a decode-step band
+        sq, sk = logits.shape[-2], logits.shape[-1]
+        cmask = jnp.tril(jnp.ones((sq, sk), bool))
+        logits = jnp.where(cmask, logits, neg)
+    probs = jax.nn.softmax(logits, axis=-1)
+    if ctx.training and p.get("dropout_p", 0.0) > 0.0:
+        keep = 1.0 - p["dropout_p"]
+        dmask = jax.random.bernoulli(ctx.rng_for(layer), keep, probs.shape)
+        probs = jnp.where(dmask, probs / keep, 0.0).astype(probs.dtype)
+    return [jnp.einsum("...qk,...kd->...qd", probs, v)]
+
+
+def _sdpa_flops(layer: Layer):
+    q, k = layer.inputs[0].spec, layer.inputs[1].spec
+    batch = 1
+    for d in q.shape[:-2]:
+        batch *= d
+    sq, d = q.shape[-2], q.shape[-1]
+    sk = k.shape[-2]
+    return 2.0 * batch * sq * sk * d * 2
+
+
+register_op(OperatorType.SDPA, _sdpa_infer, _sdpa_lower, _sdpa_flops)

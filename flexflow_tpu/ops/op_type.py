@@ -66,6 +66,10 @@ class OperatorType(enum.Enum):
     CAST = "cast"
     GATHER = "gather"
     SLICE = "slice"
+    EXPAND = "expand"
+    CONSTANT = "constant"
+    MASKED_FILL = "masked_fill"
+    WHERE = "where"
     # reductions
     REDUCE_SUM = "reduce_sum"
     REDUCE_MEAN = "reduce_mean"
@@ -79,6 +83,7 @@ class OperatorType(enum.Enum):
     SOFTMAX = "softmax"
     LOG_SOFTMAX = "log_softmax"
     MULTIHEAD_ATTENTION = "multihead_attention"
+    SDPA = "scaled_dot_product_attention"
     # MoE family (reference: src/ops/{topk,group_by,aggregate,aggregate_spec,cache}.cc)
     TOPK = "topk"
     GROUP_BY = "group_by"

@@ -146,3 +146,76 @@ register_op(
     _gather_infer,
     lambda l, i, w, c: [jnp.take_along_axis(i[0], i[1], axis=l.params["dim"])],
 )
+
+
+def _expand_infer(layer: Layer):
+    """torch.Tensor.expand semantics: -1 keeps the dim; size-1 dims broadcast;
+    new leading dims may be added."""
+    x = layer.inputs[0].spec
+    sizes = list(layer.params["sizes"])
+    lead = len(sizes) - x.ndim
+    if lead < 0:
+        raise ValueError(f"expand to fewer dims: {x.shape} -> {sizes}")
+    shape = []
+    for i, s in enumerate(sizes):
+        if i < lead:
+            shape.append(s if s != -1 else 1)
+        else:
+            d = x.shape[i - lead]
+            shape.append(d if s == -1 else s)
+    layer.params["sizes"] = tuple(shape)
+    return [x.with_shape(shape)]
+
+
+register_op(
+    OperatorType.EXPAND,
+    _expand_infer,
+    lambda l, i, w, c: [jnp.broadcast_to(i[0], l.params["sizes"])],
+)
+
+
+def _constant_infer(layer: Layer):
+    import numpy as np
+
+    from flexflow_tpu.dtype import DataType
+
+    v = np.asarray(layer.params["value"])
+    return [TensorSpec(tuple(v.shape), DataType.from_any(v.dtype))]
+
+
+def _constant_lower(layer: Layer, inputs, weights, ctx):
+    v = jnp.asarray(layer.params["value"])
+    # honor the mixed-precision policy: float constants follow compute_dtype
+    # (int/bool stay) so they don't promote bf16 neighbors back to f32
+    if ctx.compute_dtype is not None and jnp.issubdtype(v.dtype, jnp.floating):
+        v = v.astype(ctx.compute_dtype)
+    return [v]
+
+
+register_op(OperatorType.CONSTANT, _constant_infer, _constant_lower)
+
+
+def _where_infer(layer: Layer):
+    cond, a, b = [t.spec for t in layer.inputs]
+    shape = jnp.broadcast_shapes(cond.shape, a.shape, b.shape)
+    return [a.with_shape(shape)]
+
+
+register_op(
+    OperatorType.WHERE,
+    _where_infer,
+    lambda l, i, w, c: [jnp.where(i[0].astype(bool), i[1], i[2])],
+)
+
+
+def _masked_fill_infer(layer: Layer):
+    x, mask = layer.inputs[0].spec, layer.inputs[1].spec
+    shape = jnp.broadcast_shapes(x.shape, mask.shape)
+    return [x.with_shape(shape)]
+
+
+register_op(
+    OperatorType.MASKED_FILL,
+    _masked_fill_infer,
+    lambda l, i, w, c: [jnp.where(i[1].astype(bool), jnp.asarray(l.params["value"], i[0].dtype), i[0])],
+)

@@ -1,0 +1,7 @@
+"""PyTorch fx frontend (reference: python/flexflow/torch/)."""
+
+from flexflow_tpu.torch.model import (  # noqa: F401
+    PyTorchModel,
+    file_to_ff,
+    torch_to_flexflow,
+)
