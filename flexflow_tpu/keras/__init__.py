@@ -1,0 +1,12 @@
+"""Keras-compatible frontend (reference: python/flexflow/keras/).
+
+Usage mirrors the reference examples (examples/python/keras/):
+
+    from flexflow_tpu.keras.models import Model, Sequential
+    from flexflow_tpu.keras.layers import Input, Dense, Conv2D, ...
+    import flexflow_tpu.keras.optimizers
+"""
+
+from flexflow_tpu.keras import callbacks, datasets, layers, models, optimizers  # noqa: F401
+from flexflow_tpu.losses import LossType as losses  # noqa: F401
+from flexflow_tpu.metrics import MetricsType as metrics  # noqa: F401

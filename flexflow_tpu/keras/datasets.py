@@ -1,0 +1,54 @@
+"""Dataset loaders (reference: python/flexflow/keras/datasets/).
+
+The reference downloads CIFAR-10/MNIST. This environment has no network
+egress, so loaders look for local copies (KERAS_DATA_DIR or ~/.keras) and
+otherwise return deterministic synthetic data with matching shapes/dtypes —
+enough for the training-pipeline examples and tests.
+"""
+
+from __future__ import annotations
+
+import os
+import warnings
+
+import numpy as np
+
+
+def _synthetic(shape_x, n_classes, n, seed):
+    rng = np.random.default_rng(seed)
+    x = rng.integers(0, 256, size=(n,) + shape_x).astype(np.uint8)
+    y = rng.integers(0, n_classes, size=(n, 1)).astype(np.int64)
+    return x, y
+
+
+class _ImageDataset:
+    shape = (3, 32, 32)
+    classes = 10
+    fname = "cifar10.npz"
+    seed = 0
+
+    @classmethod
+    def load_data(cls, num_samples: int = 10000):
+        for base in (os.environ.get("KERAS_DATA_DIR", ""),
+                     os.path.expanduser("~/.keras/datasets")):
+            p = os.path.join(base, cls.fname) if base else ""
+            if p and os.path.exists(p):
+                d = np.load(p)
+                return ((d["x_train"][:num_samples], d["y_train"][:num_samples]),
+                        (d["x_test"], d["y_test"]))
+        warnings.warn(f"{cls.fname} not found locally; using synthetic data "
+                      "(no network egress)")
+        x, y = _synthetic(cls.shape, cls.classes, num_samples, cls.seed)
+        xt, yt = _synthetic(cls.shape, cls.classes, max(64, num_samples // 10),
+                            cls.seed + 1)
+        return (x, y), (xt, yt)
+
+
+class cifar10(_ImageDataset):
+    shape = (3, 32, 32)
+    fname = "cifar10.npz"
+
+
+class mnist(_ImageDataset):
+    shape = (28, 28)
+    fname = "mnist.npz"

@@ -1,0 +1,92 @@
+"""Keras frontend tests (reference analog: examples/python/keras smoke runs,
+tests/python_interface_test.sh). BASELINE config #1 done-criterion: the
+func_cifar10_alexnet-equivalent script runs end-to-end."""
+
+import numpy as np
+
+import flexflow_tpu.keras.optimizers as opt
+from flexflow_tpu.keras.callbacks import EpochVerifyMetrics
+from flexflow_tpu.keras.datasets import cifar10
+from flexflow_tpu.keras.layers import (
+    Activation,
+    Add,
+    BatchNormalization,
+    Concatenate,
+    Conv2D,
+    Dense,
+    Dropout,
+    Flatten,
+    Input,
+    MaxPooling2D,
+    concatenate,
+)
+from flexflow_tpu.keras.models import Model, Sequential
+
+
+def test_functional_cnn_trains():
+    (x_train, y_train), _ = cifar10.load_data(128)
+    x = (x_train / 255.0).astype(np.float32)
+    y = y_train.astype(np.int32).reshape(-1)
+    inp = Input(shape=(3, 32, 32))
+    t = Conv2D(16, (5, 5), padding=(2, 2), activation="relu")(inp)
+    t = MaxPooling2D((2, 2), (2, 2))(t)
+    t = Flatten()(t)
+    t = Dense(32, activation="relu")(t)
+    out = Activation("softmax")(Dense(10)(t))
+    m = Model(inp, out)
+    m.compile(optimizer=opt.SGD(learning_rate=0.05),
+              loss="sparse_categorical_crossentropy", metrics=["accuracy"])
+    hist = m.fit(x, y, batch_size=32, epochs=2, verbose=False)
+    assert np.isfinite(hist[-1]["loss"])
+    assert m.predict(x[:32]).shape == (32, 10)
+    ev = m.evaluate(x, y)
+    assert "accuracy" in ev
+
+
+def test_alexnet_example_builds_and_runs():
+    """The BASELINE #1 script at reduced sample count."""
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "alexnet_example",
+        os.path.join(os.path.dirname(__file__), os.pardir, "examples",
+                     "keras", "func_cifar10_alexnet.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    model = mod.build_alexnet()
+    (x_train, y_train), _ = cifar10.load_data(32)
+    x = mod.upsample_nearest(x_train, 229) / 255.0
+    y = y_train.astype(np.int32).reshape(-1)
+    model.compile(optimizer=opt.SGD(learning_rate=0.01),
+                  loss="sparse_categorical_crossentropy", metrics=["accuracy"])
+    hist = model.fit(x, y, batch_size=16, epochs=1, verbose=False,
+                     callbacks=[EpochVerifyMetrics(0.0)])
+    assert np.isfinite(hist[-1]["loss"])
+
+
+def test_sequential_and_merges():
+    rng = np.random.default_rng(0)
+    xs = rng.normal(size=(64, 16)).astype(np.float32)
+    ys = (xs.sum(1) > 0).astype(np.int32)
+
+    sm = Sequential([Dense(32, activation="relu", input_shape=(16,)),
+                     Dropout(0.1), Dense(2)])
+    sm.compile(optimizer="adam", loss="sparse_categorical_crossentropy",
+               metrics=["accuracy"])
+    hist = sm.fit(xs, ys, batch_size=32, epochs=2, verbose=False)
+    assert np.isfinite(hist[-1]["loss"])
+
+    # functional with merges (concat + residual add)
+    inp = Input(shape=(16,))
+    a = Dense(16, activation="relu")(inp)
+    b = Dense(16, activation="relu")(inp)
+    c = concatenate([a, b], axis=-1)
+    d = Dense(16)(c)
+    e = Add()([d, a])
+    out = Dense(2)(e)
+    m = Model(inp, out)
+    m.compile(optimizer=opt.Adam(learning_rate=1e-3),
+              loss="sparse_categorical_crossentropy", metrics=["accuracy"])
+    hist = m.fit(xs, ys, batch_size=32, epochs=2, verbose=False)
+    assert np.isfinite(hist[-1]["loss"])
