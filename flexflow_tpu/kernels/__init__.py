@@ -1,1 +1,10 @@
-"""Pallas TPU kernels (flash attention, ring attention, fused collectives)."""
+"""Pallas TPU kernels.
+
+flash_attention — block-wise online-softmax attention (fwd + custom VJP),
+the cuDNN-fused-attention replacement (reference src/ops/attention.cu:35).
+"""
+
+from flexflow_tpu.kernels.flash_attention import (  # noqa: F401
+    flash_attention,
+    flash_attention_qkv,
+)

@@ -97,12 +97,21 @@ def _mha_lower(layer: Layer, inputs, weights, ctx: LoweringCtx):
     causal = p.get("causal", False)
     scale = 1.0 / math.sqrt(embed // heads)
     out = None
-    if impl in ("auto", "flash"):
+    # flash kernel has no probs-dropout path: fall back (or fail under
+    # impl="flash") rather than silently dropping the dropout mask
+    needs_dropout = ctx.training and p.get("dropout", 0.0) > 0.0
+    if impl == "flash" and needs_dropout:
+        raise NotImplementedError("impl='flash' does not support attention-prob "
+                                  "dropout; use dropout=0.0 or impl='xla'")
+    if impl in ("auto", "flash") and not needs_dropout:
         try:
             from flexflow_tpu.kernels.flash_attention import flash_attention_qkv
 
-            out = flash_attention_qkv(qh, kh, vh, causal=causal, scale=scale, force=(impl == "flash"))
+            out = flash_attention_qkv(qh, kh, vh, causal=causal, scale=scale)
         except Exception:
+            # auto falls back to the einsum path on ANY flash failure
+            # (unsupported shapes raise ValueError; the experimental pallas
+            # stack may raise other types at trace time)
             if impl == "flash":
                 raise
             out = None
