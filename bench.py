@@ -41,28 +41,17 @@ def _time_steps(cm, inputs, labels, iters: int, key):
     return float(loss)
 
 
-def main():
+def _bench_model(cfg, batch, searched: bool, on_cpu: bool):
+    """Build + train-bench GPT-2 under one strategy; returns samples/sec."""
     import jax
 
     from flexflow_tpu import AdamOptimizer, FFConfig, FFModel
-    from flexflow_tpu.models import GPT2Config, build_gpt2
-    from flexflow_tpu.parallel.machine import MachineSpec
+    from flexflow_tpu.models import build_gpt2
 
-    machine = MachineSpec.detect()
-    on_cpu = jax.devices()[0].platform == "cpu"
-
-    if on_cpu:  # CI / no-TPU fallback keeps runtime sane
-        cfg = GPT2Config.tiny(seq=128)
-        batch = 4
-    else:
-        # BASELINE config #5: GPT-2 medium, seq 1024
-        cfg = GPT2Config.medium()
-        batch = 8
-
-    ff_cfg = FFConfig(batch_size=batch, only_data_parallel=True,
-                      compute_dtype="bfloat16")
+    ff_cfg = FFConfig(batch_size=batch, compute_dtype="bfloat16",
+                      only_data_parallel=not searched,
+                      search_budget=32 if searched else 0)
     model = FFModel(ff_cfg)
-    cfg.dropout = 0.0
     build_gpt2(model, cfg, batch=batch)
     cm = model.compile(AdamOptimizer(alpha=1e-4),
                        loss_type="sparse_categorical_crossentropy", metrics=[])
@@ -84,7 +73,32 @@ def main():
         t0 = time.perf_counter()
         _time_steps(cm, [ids, pos], labels, iters, jax.random.fold_in(key, rep))
         best_dt = min(best_dt, time.perf_counter() - t0)
-    sps = iters * batch / best_dt
+    return iters * batch / best_dt, best_dt / iters
+
+
+def main():
+    import jax
+
+    from flexflow_tpu.models import GPT2Config
+    from flexflow_tpu.parallel.machine import MachineSpec
+
+    machine = MachineSpec.detect()
+    on_cpu = jax.devices()[0].platform == "cpu"
+
+    if on_cpu:  # CI / no-TPU fallback keeps runtime sane
+        cfg = GPT2Config.tiny(seq=128)
+        batch = 4
+    else:
+        # BASELINE config #5: GPT-2 medium, seq 1024
+        cfg = GPT2Config.medium()
+        batch = 8
+    cfg.dropout = 0.0
+
+    # expert strategy (hand-tuned data-parallel anchor) = the reported metric;
+    # the auto-searched strategy on the same mesh gives BASELINE's second
+    # north-star: searched_vs_expert (target >= 0.90)
+    sps, step_dt = _bench_model(cfg, batch, searched=False, on_cpu=on_cpu)
+    searched_sps, _ = _bench_model(cfg, batch, searched=True, on_cpu=on_cpu)
 
     n_chips = max(1, len(jax.devices()))
     sps_chip = sps / n_chips
@@ -109,7 +123,8 @@ def main():
         "unit": "samples/s/chip",
         "vs_baseline": round(sps_chip / ref_sps, 4),
         "mfu": round(mfu, 4),
-        "step_ms": round(best_dt / iters * 1e3, 2),
+        "step_ms": round(step_dt * 1e3, 2),
+        "searched_vs_expert": round(searched_sps / sps, 4),
         "batch": batch,
         "seq": cfg.seq,
         "chip_peak_tflops": round(machine.flops / 1e12, 1),

@@ -119,9 +119,14 @@ class BaseModel:
         batch_size = self._batch_size or batch_size or n
         ff = self._build(batch_size)
         outs = []
-        for lo in range(0, n - batch_size + 1, batch_size):
+        for lo in range(0, n, batch_size):
             chunk = [np.asarray(a)[lo:lo + batch_size] for a in xs]
-            outs.append(np.asarray(ff.forward(*chunk)))
+            got = len(chunk[0])
+            if got < batch_size:  # pad the tail batch, trim below
+                chunk = [np.concatenate(
+                    [c, np.repeat(c[-1:], batch_size - got, axis=0)]) for c in chunk]
+            out = np.asarray(ff.forward(*chunk))
+            outs.append(out[:got])
         return np.concatenate(outs, axis=0) if outs else np.empty((0,))
 
     def summary(self) -> str:

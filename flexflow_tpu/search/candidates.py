@@ -47,6 +47,11 @@ class Candidate:
     compute_degree: int = 1
     extra_comm: float = 0.0  # collectives inherent to this placement (s)
     eff: float = 1.0  # MXU-tile granularity efficiency (shards < 128 lanes waste MXU)
+    # passthrough: identity layout op — adopts whatever layout arrives (minus
+    # drop_axis) with zero cost. Used by engine-inserted Replicate/Reduction
+    # marker nodes so they never force a gather of the batch sharding.
+    passthrough: bool = False
+    drop_axis: Optional[str] = None
 
     def op_time(self, layer: "Layer", machine: MachineSpec) -> float:
         od = get_op_def(layer.op_type)
@@ -246,11 +251,19 @@ def layer_candidates(layer: "Layer", machine: MachineSpec, batch_sizes,
                                        eff=min(1.0, (x.shape[-1] // dm) / machine.mxu_min_dim)))
 
     elif t in PARALLEL_OPS:
-        # explicit parallel op: its requested layout IS the candidate; pricing
-        # happens at the incoming edge (reshard incoming→requested), the op
-        # itself is free — so in_dims = out_dims = requested.
         from flexflow_tpu.ops.parallel_ops import requested_dims
 
+        # Reduction (and engine-inserted axis-scoped Replicate) are layout
+        # markers: they adopt the incoming layout (Replicate guarantees the
+        # named axis is unused, i.e. replicated-over). The DP handles these
+        # as passthrough so they never gather the batch sharding.
+        if t is OperatorType.REDUCTION or (
+                t is OperatorType.REPLICATE and "axis" in layer.params):
+            return [Candidate("passthrough", [], [], {}, passthrough=True,
+                              drop_axis=layer.params.get("axis"))]
+        # other parallel ops: the requested layout IS the candidate; pricing
+        # happens at the incoming edge (reshard incoming→requested), the op
+        # itself is free — so in_dims = out_dims = requested.
         dims = requested_dims(layer)
         return [Candidate("requested", [list(dims)], [list(dims)], {},
                           compute_degree=1)]

@@ -39,6 +39,17 @@ def _freeze_dims(dims) -> Tuple:
     return tuple(out)
 
 
+def _drop_axis(d, ax):
+    if ax is None:
+        return d
+    if d == ax:
+        return None
+    if isinstance(d, tuple):
+        kept = tuple(a for a in d if a != ax)
+        return kept if len(kept) > 1 else (kept[0] if kept else None)
+    return d
+
+
 def _score(cost: float, mem: int, mem_budget: float) -> float:
     """Cost scaled by a quadratic over-HBM penalty (memory-aware lambda
     analog). Multiplicative so the penalty has the same units as the cost;
@@ -59,9 +70,15 @@ class SearchResult:
 def search_graph(model, machine: MachineSpec, beam_width: int = 64,
                  enable_parameter: bool = True, enable_attribute: bool = True,
                  mem_budget: Optional[float] = None,
-                 cost_fn=None) -> SearchResult:
+                 cost_fn=None,
+                 pins: Optional[Dict[str, str]] = None) -> SearchResult:
     """cost_fn(layer, cand) -> seconds overrides the analytic op time
-    (hook for the measured path, search/measure.py)."""
+    (hook for the measured path, search/measure.py).
+
+    `model` is anything with .layers / .input_tensors (FFModel or a PCG).
+    `pins` restricts named layers to one candidate (by candidate name) — the
+    substitution engine's hook: a rewritten PCG is costed with its rewrite
+    choices pinned while the DP still lays out every unpinned op."""
     layers = topo_order(model.layers)
     batch_sizes = {t.shape[0] for t in model.input_tensors if t.ndim > 0}
     mem_budget = mem_budget or machine.hbm_bytes
@@ -101,6 +118,13 @@ def search_graph(model, machine: MachineSpec, beam_width: int = 64,
             specs[o.guid] = o.spec
         cands = layer_candidates(layer, machine, batch_sizes,
                                  enable_parameter, enable_attribute)
+        if pins and layer.name in pins:
+            want = pins[layer.name]
+            sel = [c for c in cands if c.name == want]
+            if not sel:
+                raise KeyError(f"pinned candidate {want!r} not available for "
+                               f"{layer.name} (have {[c.name for c in cands]})")
+            cands = sel
         cand_cache[layer.name] = cands
         new_beam: Dict[Tuple, Tuple[float, int, int, Tuple]] = {}
         for frontier, (cost, w_mem, act_high, trace) in beam.items():
@@ -108,24 +132,34 @@ def search_graph(model, machine: MachineSpec, beam_width: int = 64,
             fmap_act = _live_act_bytes(fmap)
             for ci, cand in enumerate(cands):
                 c = cost
-                # edge costs: reshard each input from its frontier layout
-                feasible = True
-                for ii, tin in enumerate(layer.inputs):
-                    cur = fmap.get(tin.guid)
-                    if cur is None:
-                        feasible = False
-                        break
-                    want = _freeze_dims(cand.in_dims[ii] if ii < len(cand.in_dims)
-                                        else [None] * tin.spec.ndim)
-                    c += cm.reshard_time(tin.spec, list(cur), list(want), machine)
-                if not feasible:
-                    continue
-                c += cost_fn(layer, cand) if cost_fn else cand.op_time(layer, machine)
-                wm = w_mem + cand.weight_mem_bytes(layer, machine)
-                out_dims = {
-                    o.guid: _freeze_dims(cand.out_dims[oi] if oi < len(cand.out_dims)
-                                         else [None] * o.spec.ndim)
-                    for oi, o in enumerate(layer.outputs)}
+                if cand.passthrough:
+                    # identity layout marker: adopt input-0's layout (minus
+                    # drop_axis), zero cost, no reshard
+                    cur0 = fmap.get(layer.inputs[0].guid) if layer.inputs else None
+                    if cur0 is None:
+                        continue
+                    od = tuple(_drop_axis(d, cand.drop_axis) for d in cur0)
+                    wm = w_mem
+                    out_dims = {o.guid: od for o in layer.outputs}
+                else:
+                    # edge costs: reshard each input from its frontier layout
+                    feasible = True
+                    for ii, tin in enumerate(layer.inputs):
+                        cur = fmap.get(tin.guid)
+                        if cur is None:
+                            feasible = False
+                            break
+                        want = _freeze_dims(cand.in_dims[ii] if ii < len(cand.in_dims)
+                                            else [None] * tin.spec.ndim)
+                        c += cm.reshard_time(tin.spec, list(cur), list(want), machine)
+                    if not feasible:
+                        continue
+                    c += cost_fn(layer, cand) if cost_fn else cand.op_time(layer, machine)
+                    wm = w_mem + cand.weight_mem_bytes(layer, machine)
+                    out_dims = {
+                        o.guid: _freeze_dims(cand.out_dims[oi] if oi < len(cand.out_dims)
+                                             else [None] * o.spec.ndim)
+                        for oi, o in enumerate(layer.outputs)}
                 # peak while this layer runs: ALL its inputs (even those dying
                 # here) are live together with its outputs (out guids are new,
                 # so the two contributions are disjoint)

@@ -34,8 +34,10 @@ def result_to_strategy(model, machine: MachineSpec, result: SearchResult) -> Str
 
 def graph_optimize(model, machine: MachineSpec,
                    measured: bool = False) -> Strategy:
+    """Unity search: graph substitutions (best-first under budget/alpha) over
+    the frontier DP. Falls back to the plain DP when the engine is disabled
+    (enable_parameter_parallel=False etc. restricts candidates either way)."""
     cfg = model.config
-    beam = max(16, cfg.search_budget)
     cost_fn = None
     if measured or cfg.profiling:
         try:
@@ -44,15 +46,9 @@ def graph_optimize(model, machine: MachineSpec,
             cost_fn = MeasuredCost(machine).op_time
         except Exception:
             cost_fn = None
-    result = search_graph(
-        model, machine, beam_width=beam,
-        enable_parameter=cfg.enable_parameter_parallel and not cfg.only_data_parallel,
-        enable_attribute=cfg.enable_attribute_parallel and not cfg.only_data_parallel,
-        mem_budget=machine.hbm_bytes if cfg.memory_search else None,
-        cost_fn=cost_fn,
-    )
-    st = result_to_strategy(model, machine, result)
-    st.name = f"searched(cost={result.cost * 1e3:.3f}ms, mem={result.mem_bytes / 1e9:.2f}GB)"
+    from flexflow_tpu.search.unity import unity_optimize
+
+    st, _stats = unity_optimize(model, machine, cost_fn=cost_fn)
     return st
 
 

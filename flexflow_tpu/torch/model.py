@@ -57,11 +57,17 @@ def module_to_spec(module) -> Dict[str, Any]:
                 "padding": _pair(m.padding), "groups": m.groups,
                 "bias": m.bias is not None}
     if isinstance(m, nn.MaxPool2d):
+        if m.ceil_mode or _pair(m.dilation) != (1, 1) or m.return_indices:
+            raise NotImplementedError(
+                "MaxPool2d ceil_mode/dilation/return_indices import unsupported "
+                "(would silently change output shapes/values)")
         return {"cls": "Pool2d", "pool_type": "max",
                 "kernel_size": _pair(m.kernel_size),
                 "stride": _pair(m.stride or m.kernel_size),
                 "padding": _pair(m.padding)}
     if isinstance(m, nn.AvgPool2d):
+        if m.ceil_mode:
+            raise NotImplementedError("AvgPool2d ceil_mode import unsupported")
         return {"cls": "Pool2d", "pool_type": "avg",
                 "kernel_size": _pair(m.kernel_size),
                 "stride": _pair(m.stride or m.kernel_size),
@@ -177,6 +183,20 @@ MODULE_HANDLERS: Dict[str, Callable] = {
 
 
 def _h_mha(im, spec, args, kwargs, name):
+    # forward(q, k, v, key_padding_mask=None, need_weights=True,
+    #         attn_mask=None, average_attn_weights=True, is_causal=False)
+    def arg(pos, kw, default=None):
+        if len(args) > pos:
+            return args[pos]
+        return kwargs.get(kw, default)
+
+    # masks would be silently dropped (unmasked attention with wrong
+    # numerics) — fail loudly instead, like dilated conv / strided slices
+    if arg(3, "key_padding_mask") is not None or arg(5, "attn_mask") is not None:
+        raise NotImplementedError(
+            "MultiheadAttention attn_mask/key_padding_mask import unsupported; "
+            "use is_causal=True or drop the mask")
+    is_causal = bool(arg(7, "is_causal", False))
     q, k, v = (im.as_tensor(a) for a in args[:3])
     if not spec["batch_first"]:
         # our MHA is batch-first; transpose in and out
@@ -186,7 +206,7 @@ def _h_mha(im, spec, args, kwargs, name):
     out = im.ff.multihead_attention(
         q, k, v, spec["embed_dim"], spec["num_heads"], dropout=spec["dropout"],
         bias=spec["bias"], add_bias_kv=spec["add_bias_kv"],
-        add_zero_attn=spec["add_zero_attn"], name=name)
+        add_zero_attn=spec["add_zero_attn"], causal=is_causal, name=name)
     if not spec["batch_first"]:
         out = im.ff.transpose(out, (1, 0, 2), name=f"{name}_oT")
     # torch returns (attn_output, attn_weights); weights path unsupported
@@ -206,9 +226,8 @@ def _is_t(v) -> bool:
 
 
 def _np(v):
-    import torch as _torch
-
-    if isinstance(v, _torch.Tensor):
+    # avoid importing torch on the replay path (file_to_ff runs torch-less)
+    if type(v).__module__.startswith("torch"):
         return v.detach().cpu().numpy()
     return np.asarray(v)
 
@@ -391,7 +410,18 @@ def _h_chunk(im, args, kwargs, name):
     x = im.as_tensor(args[0])
     n = args[1]
     axis = args[2] if len(args) > 2 else kwargs.get("dim", 0)
-    return tuple(im.ff.split(x, n, axis=axis, name=name))
+    d = x.shape[axis % x.ndim]
+    if d % n == 0:
+        return tuple(im.ff.split(x, n, axis=axis, name=name))
+    # torch.chunk semantics for non-divisible dims: ceil-div chunk size,
+    # smaller final chunk, possibly fewer than n chunks
+    size = -(-d // n)
+    sizes = []
+    rem = d
+    while rem > 0:
+        sizes.append(min(size, rem))
+        rem -= size
+    return tuple(im.ff.split(x, sizes, axis=axis, name=name))
 
 
 def _h_flatten(im, args, kwargs, name):
@@ -514,19 +544,28 @@ def _h_expand(im, args, kwargs, name):
     return im.ff.expand(x, sizes, name=name)
 
 
-def _h_to(im, args, kwargs, name):
-    import torch as _torch
+class _DTypeName(str):
+    """Marker for dtype names decoded from a .ff file's "$dtype" records —
+    distinguishes them from ordinary string args without importing torch."""
 
+
+def _h_to(im, args, kwargs, name):
     from flexflow_tpu.dtype import DataType as _DT
 
     x = args[0]
     target = kwargs.get("dtype", args[1] if len(args) > 1 else None)
-    if isinstance(target, (_torch.dtype, _DT)):
-        dt = str(_as_torch_dtype(target)).replace("torch.", "")
-        if not _is_t(x):
-            return _np(x).astype(_TORCH_NP.get(dt, dt))
-        return im.ff.cast(x, _DTYPE_ALIAS.get(dt, dt), name=name)
-    return x  # device / copy moves are no-ops
+    dt = None
+    if isinstance(target, _DTypeName):
+        dt = str(target)
+    elif isinstance(target, _DT):
+        dt = target.value
+    elif target is not None and type(target).__module__.startswith("torch"):
+        dt = str(target).replace("torch.", "")
+    if dt is None:
+        return x  # device / copy moves are no-ops
+    if not _is_t(x):
+        return _np(x).astype(_TORCH_NP.get(dt, dt))
+    return im.ff.cast(x, _DTYPE_ALIAS.get(dt, dt), name=name)
 
 
 _TORCH_NP = {"float32": np.float32, "float64": np.float32, "float16": np.float16,
@@ -687,9 +726,9 @@ class _Importer:
         if isinstance(a, dict) and "$ellipsis" in a:
             return Ellipsis
         if isinstance(a, dict) and "$dtype" in a:
-            import torch as _torch
-
-            return getattr(_torch, a["$dtype"])
+            # dtype-name marker string: keeps .ff replay torch-free
+            # (_h_to recognizes _DTypeName unambiguously)
+            return _DTypeName(a["$dtype"])
         if isinstance(a, dict) and "$dict" in a:
             return {k: self.resolve(v) for k, v in a["$dict"].items()}
         return a
