@@ -312,6 +312,28 @@ class CompiledModel:
             self._build_steps()
 
     # ------------------------------------------------------------- weights
+    def parallel_view(self, layer_name: str, out_idx: int = 0):
+        """The ParallelTensor view of a layer output under the compiled
+        strategy: per-dim degrees, shard shape, replica axes (reference
+        ParallelTensorBase, include/flexflow/parallel_tensor.h:134-198)."""
+        from flexflow_tpu.parallel.ptensor import ParallelTensor
+
+        layer = self.model.get_layer_by_name(layer_name)
+        sh = self.strategy.op_shardings.get(layer_name)
+        dims = sh.outputs[out_idx] if sh and out_idx < len(sh.outputs) else []
+        return ParallelTensor.build(layer.outputs[out_idx].spec, list(dims),
+                                    self.machine)
+
+    def weight_view(self, layer_name: str, wname: str = "kernel"):
+        """ParallelTensor view of a weight under the compiled strategy."""
+        from flexflow_tpu.parallel.ptensor import ParallelTensor
+
+        layer = self.model.get_layer_by_name(layer_name)
+        sh = self.strategy.op_shardings.get(layer_name)
+        dims = (sh.weights.get(wname, []) if sh else [])
+        return ParallelTensor.build(layer.weight_specs[wname], list(dims),
+                                    self.machine)
+
     def get_weight(self, layer_name: str, wname: str = "kernel") -> np.ndarray:
         return np.asarray(self.params[layer_name][wname])
 

@@ -70,11 +70,6 @@ class ParallelTensor:
             n *= s
         return n * self.spec.dtype.itemsize
 
-    @property
-    def replica_degree(self) -> int:
-        # how many copies of each shard exist (reference: is_replica_dim)
-        return 1  # replica axes hold copies; degree bookkeeping via replica_axes
-
     def __repr__(self):
         parts = [f"{pd.size}/{pd.degree}" + (f"@{'+'.join(pd.axes)}" if pd.axes else "")
                  for pd in self.dims]

@@ -134,11 +134,18 @@ def search_graph(model, machine: MachineSpec, beam_width: int = 64,
                 c = cost
                 if cand.passthrough:
                     # identity layout marker: adopt input-0's layout (minus
-                    # drop_axis), zero cost, no reshard
+                    # drop_axis). When dropping the axis actually changes the
+                    # layout (the input really was sharded over it), the
+                    # implied all-gather is priced — a free drop would let
+                    # the search hide a real collective (e.g. a tp_col
+                    # output feeding a later rewrite's Replicate).
                     cur0 = fmap.get(layer.inputs[0].guid) if layer.inputs else None
                     if cur0 is None:
                         continue
                     od = tuple(_drop_axis(d, cand.drop_axis) for d in cur0)
+                    if od != cur0:
+                        c += cm.reshard_time(layer.inputs[0].spec,
+                                             list(cur0), list(od), machine)
                     wm = w_mem
                     out_dims = {o.guid: od for o in layer.outputs}
                 else:

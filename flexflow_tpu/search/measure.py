@@ -27,19 +27,12 @@ if TYPE_CHECKING:
 
 from flexflow_tpu.ops.registry import LoweringCtx, get_op_def
 from flexflow_tpu.parallel.machine import MachineSpec
+from flexflow_tpu.parallel.ptensor import ParallelTensor
 from flexflow_tpu.search import cost_model as cm
 
 
-def _shard_shape(shape, dims, machine):
-    out = []
-    for i, s in enumerate(shape):
-        d = dims[i] if dims and i < len(dims) else None
-        axes = () if d is None else ((d,) if isinstance(d, str) else tuple(d))
-        deg = 1
-        for a in axes:
-            deg *= machine.mesh_axes.get(a, 1)
-        out.append(max(1, s // max(1, deg)))
-    return tuple(out)
+def _shard_shape(spec, dims, machine):
+    return ParallelTensor.build(spec, list(dims or []), machine).shard_shape
 
 
 class MeasuredCost:
@@ -67,7 +60,7 @@ class MeasuredCost:
         rng = np.random.default_rng(0)
         ins = []
         for i, tin in enumerate(layer.inputs):
-            shp = _shard_shape(tin.spec.shape, cand.in_dims[i] if i < len(cand.in_dims) else None, machine)
+            shp = _shard_shape(tin.spec, cand.in_dims[i] if i < len(cand.in_dims) else None, machine)
             dt = tin.spec.dtype.jnp_dtype
             if jnp.issubdtype(dt, jnp.integer):
                 ins.append(jnp.asarray(rng.integers(0, 2, size=shp), dt))
@@ -75,7 +68,7 @@ class MeasuredCost:
                 ins.append(jnp.asarray(rng.normal(size=shp), dt))
         weights = {}
         for w, spec in layer.weight_specs.items():
-            shp = _shard_shape(spec.shape, cand.weight_dims.get(w), machine)
+            shp = _shard_shape(spec, cand.weight_dims.get(w), machine)
             weights[w] = jnp.asarray(rng.normal(size=shp), spec.dtype.jnp_dtype)
 
         lower = get_op_def(layer.op_type).lower

@@ -333,34 +333,64 @@ def load_substitution_json(path: str, machine: MachineSpec) -> Tuple[List[GraphX
     PARTITION/COMBINE/REPLICATE/REDUCE with PM_PARALLEL_DIM/DEGREE params)
     around the compute vocabulary above. PM_PARALLEL_DIM uses the
     reference's reversed (Legion) dim order; it is converted at apply time
-    (dim -> ndim-1-dim). Degrees are mapped to the mesh axis of equal size;
-    rules whose degree matches no axis are skipped. Returns (xfers, report)
-    where report counts loaded/skipped rules."""
+    (dim -> ndim-1-dim). PM_PARALLEL_DEGREE==2 is the schema's placeholder
+    degree (reference substitution.cc:1487 asserts value==2, then
+    instantiates the rule once per runtime parallel degree); it is treated
+    as a wildcard instantiated once per model mesh axis. Literal degrees
+    other than 2 map to the mesh axis of equal size; rules whose degree
+    matches no axis are skipped. Returns (xfers, report) where report counts
+    loaded/skipped RULES ("loaded") and emitted xfers ("instantiated")."""
+    from flexflow_tpu.search.candidates import _model_axes
+
     with open(path) as f:
         doc = json.load(f)
     rules = doc["rule"] if isinstance(doc, dict) else doc
     deg_to_axis = {}
     for a, n in machine.mesh_axes.items():
         deg_to_axis.setdefault(n, a)
+    wildcard_axes = _model_axes(machine) or \
+        ([deg_to_axis[2]] if 2 in deg_to_axis else [])
     xfers: List[GraphXfer] = []
     skipped = {"unsupported_op": 0, "degree_unmatched": 0, "shape": 0}
+    loaded_rules = 0
     for rule in rules:
-        x = _compile_json_rule(rule, deg_to_axis)
-        if isinstance(x, str):
-            skipped[x] += 1
+        got_any = False
+        last_err = None
+        # per-axis instantiation only matters when the rule actually uses the
+        # placeholder degree 2; literal-degree rules compile once
+        has_deg2 = any(p.get("PM_PARALLEL_DEGREE") == 2
+                       for side in ("srcOp", "dstOp") for op in rule[side]
+                       for p in [_params_of(op)])
+        axes = (wildcard_axes or [None]) if has_deg2 else [None]
+        for ax in axes:
+            x = _compile_json_rule(rule, deg_to_axis, wildcard_axis=ax)
+            if isinstance(x, str):
+                last_err = x
+            else:
+                xfers.append(x)
+                got_any = True
+        if got_any:
+            loaded_rules += 1
         else:
-            xfers.append(x)
-    return xfers, {"loaded": len(xfers), **skipped, "total": len(rules)}
+            skipped[last_err or "degree_unmatched"] += 1
+    return xfers, {"loaded": loaded_rules, **skipped,
+                   "instantiated": len(xfers), "total": len(rules)}
 
 
-def _compile_json_rule(rule: dict, deg_to_axis: Dict[int, str]):
+def _compile_json_rule(rule: dict, deg_to_axis: Dict[int, str],
+                       wildcard_axis: Optional[str] = None):
     name = rule.get("name", "json_rule")
+    if wildcard_axis is not None:
+        name = f"{name}:{wildcard_axis}"
 
     def conv(op_json):
         t = op_json["type"]
         p = _params_of(op_json)
         if t in _JSON_PARALLEL:
             deg = p.get("PM_PARALLEL_DEGREE")
+            # degree 2 is the schema placeholder: bind to the wildcard axis
+            if deg == 2 and wildcard_axis is not None:
+                return (_JSON_PARALLEL[t], p, wildcard_axis)
             if deg not in deg_to_axis:
                 return "degree_unmatched"
             return (_JSON_PARALLEL[t], p, deg_to_axis[deg])
@@ -381,6 +411,33 @@ def _compile_json_rule(rule: dict, deg_to_axis: Dict[int, str]):
                 else:
                     ins.append(("op", t["opId"], t["tsId"]))
             out.append((c[0], c[1], c[2], ins))
+
+    # Dst compute ops take params/identity from the corresponding src op of
+    # the same type (k-th dst occurrence of a type ↔ k-th src occurrence);
+    # their output shapes are re-derived via the op registry's shape
+    # inference at apply time. A dst compute op with no same-type src
+    # counterpart is synthesized from its JSON params alone — possible for
+    # the weightless vocabulary (relu/add/mul/concat/split); a weighted op
+    # (linear) without a counterpart has no weights to inherit — reject.
+    _DERIVABLE = {OperatorType.RELU, OperatorType.EW_ADD, OperatorType.EW_MUL,
+                  OperatorType.CONCAT, OperatorType.SPLIT}
+    src_by_type: Dict[OperatorType, List[int]] = {}
+    for i, (t, _p, _ax, _ins) in enumerate(src_ops):
+        if _ax is None:
+            src_by_type.setdefault(t, []).append(i)
+    dst_src_of: Dict[int, Optional[int]] = {}
+    seen_of_type: Dict[OperatorType, int] = {}
+    for i, (t, _p, _ax, _ins) in enumerate(dst_ops):
+        if _ax is None:  # compute op
+            k = seen_of_type.get(t, 0)
+            seen_of_type[t] = k + 1
+            cands = src_by_type.get(t, [])
+            if k < len(cands):
+                dst_src_of[i] = cands[k]
+            elif t in _DERIVABLE:
+                dst_src_of[i] = None
+            else:
+                return "unsupported_op"
 
     mapped = [(m["srcOpId"], m["srcTsId"], m["dstOpId"], m["dstTsId"])
               for m in rule.get("mappedOutput", [])]
@@ -421,7 +478,7 @@ def _compile_json_rule(rule: dict, deg_to_axis: Dict[int, str]):
                     ext[spec[1]] = tin
         # instantiate dst ops
         new_nodes: List[Layer] = []
-        for (t, p, ax, ins) in dst_ops:
+        for di, (t, p, ax, ins) in enumerate(dst_ops):
             inputs = []
             for spec in ins:
                 if spec[0] == "ext":
@@ -429,20 +486,61 @@ def _compile_json_rule(rule: dict, deg_to_axis: Dict[int, str]):
                         return None
                     inputs.append(ext[spec[1]])
                 else:
-                    inputs.append(new_nodes[spec[1]].outputs[0])
+                    inputs.append(new_nodes[spec[1]].outputs[spec[2]])
             if t in (OperatorType.REPARTITION, OperatorType.COMBINE):
                 nd = inputs[0].spec.ndim
                 params = {"dim": nd - 1 - p["PM_PARALLEL_DIM"], "axis": ax}
+                node = Layer(t, params, inputs)
+                node.add_output(inputs[0].spec, 0)  # layout op: shape unchanged
             elif t in (OperatorType.REPLICATE, OperatorType.REDUCTION):
-                params = {"axis": ax}
+                node = Layer(t, {"axis": ax}, inputs)
+                node.add_output(inputs[0].spec, 0)
             else:
-                params = dict(nmatch[0].params)  # compute op inherits params
-            node = Layer(t, params, inputs)
-            node.add_output(inputs[0].spec, 0)
+                # compute op: inherit params + name (= model identity) from
+                # the corresponding matched src op when one exists, else
+                # synthesize params from the JSON para; re-run registry shape
+                # inference so shape-changing ops (linear/concat/split) get
+                # true output specs
+                src_j = dst_src_of.get(di)
+                if src_j is not None:
+                    src_l = nmatch[src_j]
+                    params = dict(src_l.params)
+                    # PM_ACTI=0 means the rule strips a fused activation out
+                    # into an explicit node (e.g. taso_rule_169)
+                    if t is OperatorType.LINEAR and p.get("PM_ACTI") == 0:
+                        params["activation"] = None
+                    node = Layer(t, params, inputs, name=src_l.name)
+                elif t is OperatorType.CONCAT:
+                    nd = p.get("PM_NUMDIM", inputs[0].spec.ndim)
+                    node = Layer(t, {"axis": nd - 1 - p.get("PM_AXIS", 0)}, inputs)
+                elif t is OperatorType.SPLIT:
+                    nd = inputs[0].spec.ndim
+                    axis = nd - 1 - p.get("PM_AXIS", 0)
+                    n_out = p.get("PM_NUM_OUTPUTS", 2)
+                    dim = inputs[0].spec.shape[axis]
+                    if n_out <= 0 or dim % n_out:
+                        return None
+                    node = Layer(t, {"axis": axis,
+                                     "sizes": [dim // n_out] * n_out}, inputs)
+                else:  # relu / ew_add / ew_mul
+                    node = Layer(t, {}, inputs)
+                try:
+                    from flexflow_tpu.ops.registry import get_op_def
+
+                    ospecs = get_op_def(t).infer(node)
+                except Exception:
+                    return None
+                for oi, ospec in enumerate(ospecs):
+                    node.add_output(ospec, oi)
             new_nodes.append(node)
-        # rewire mapped outputs, remove matched src ops
+        # rewire mapped outputs, remove matched src ops; a mapped output must
+        # exist and keep the logical shape its consumers were built against
         for si, sp, di, dp in mapped:
             src_t = nmatch[si].outputs[sp]
+            if dp >= len(new_nodes[di].outputs):
+                return None
+            if new_nodes[di].outputs[dp].spec.shape != src_t.spec.shape:
+                return None
             for cl, ii in ng.consumers(src_t):
                 if cl not in nmatch:
                     cl.inputs[ii] = new_nodes[di].outputs[dp]

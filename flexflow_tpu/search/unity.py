@@ -44,6 +44,10 @@ class UnityStats:
     best_cost: float = 0.0
     baseline_cost: float = 0.0
     json_rules: Optional[Dict] = None
+    # rewrite path to the winner: ((xfer_index, matched topo positions), ...)
+    # — replayable onto a structurally identical graph (segment memoization)
+    best_path: Tuple = ()
+    segments_replayed: int = 0
 
     @property
     def improvement(self) -> float:
@@ -75,14 +79,16 @@ def substitution_optimize(pcg: PCG, machine: MachineSpec,
     best, best_r = pcg, r0
     seen = {pcg.key()}
     counter = 0  # heap tiebreak
-    heap: List[Tuple[float, int, PCG]] = [(r0.cost, counter, pcg)]
+    heap: List[Tuple[float, int, PCG, Tuple]] = [(r0.cost, counter, pcg, ())]
     while heap and stats.expansions < budget:
-        c, _, g = heapq.heappop(heap)
+        c, _, g, path = heapq.heappop(heap)
         if c > alpha * best_r.cost:
             stats.pruned += 1
             continue
         stats.expansions += 1
-        for xfer in xfers:
+        order = topo_order(g.layers)
+        pos = {id(l): i for i, l in enumerate(order)}
+        for xi, xfer in enumerate(xfers):
             for match in find_matches(xfer.src, g):
                 try:
                     ng = xfer.apply(g, match)
@@ -100,13 +106,35 @@ def substitution_optimize(pcg: PCG, machine: MachineSpec,
                 except (KeyError, RuntimeError):
                     continue  # infeasible rewrite (pin missing / dead end)
                 stats.generated += 1
+                npath = path + ((xi, tuple(pos[id(m)] for m in match)),)
                 if nr.cost < best_r.cost:
                     best, best_r = ng, nr
+                    stats.best_path = npath
                 if nr.cost <= alpha * best_r.cost:
                     counter += 1
-                    heapq.heappush(heap, (nr.cost, counter, ng))
+                    heapq.heappush(heap, (nr.cost, counter, ng, npath))
     stats.best_cost = best_r.cost
     return best, best_r, stats
+
+
+def replay_path(pcg: PCG, xfers: List[GraphXfer], path) -> Optional[PCG]:
+    """Re-apply a recorded rewrite path onto a structurally identical PCG
+    (layer names differ; topo positions coincide). Returns None when any step
+    no longer applies — the caller falls back to a full search."""
+    g = pcg
+    for xi, positions in path:
+        order = topo_order(g.layers)
+        if any(p >= len(order) for p in positions) or xi >= len(xfers):
+            return None
+        match = [order[p] for p in positions]
+        try:
+            ng = xfers[xi].apply(g, match)
+        except (KeyError, ValueError):
+            ng = None
+        if ng is None:
+            return None
+        g = ng
+    return g
 
 
 # ----------------------------------------------------- sequence splitting
@@ -274,29 +302,63 @@ def unity_optimize(model, machine: MachineSpec, cost_fn=None) -> Tuple[Strategy,
     pcg = PCG.from_model(model)
     mem_budget = machine.hbm_bytes if cfg.memory_search else None
     segments = _segment_pcgs(pcg, max(2, cfg.base_optimize_threshold), machine)
-    # budget is split across segments; identical segments hit the same
-    # rewrites so per-segment budget stays effective (GPT-2's repeated blocks)
-    seg_budget = max(8, cfg.search_budget // max(1, len(segments)))
+    # search_budget is a GLOBAL expansion budget: structurally identical
+    # segments (GPT-2's repeated blocks — equal PCG canonical keys) are
+    # searched ONCE and the winning rewrite path is replayed onto the rest,
+    # so the budget divides over the UNIQUE segment shapes only.
+    # budget widens the layout-DP beam (quality knob, round-3 advisor) but is
+    # capped so costing work doesn't scale quadratically with --budget
+    beam_width = max(16, min(cfg.search_budget, 64))
+    keys = [seg.key() for seg in segments]
+    budget_left = max(8, cfg.search_budget)
+    memo: Dict[Tuple, Tuple] = {}  # seg key -> (path, baseline_cost)
     st = Strategy(mesh_axes=dict(machine.mesh_axes), name="unity")
     model_layer_names = {l.name for l in model.layers}
     model_input_names = {t.name for t in model.input_tensors}
     for t in model.input_tensors:
         batch_sizes = {x.shape[0] for x in model.input_tensors if x.ndim > 0}
         st.input_shardings[t.name] = _dp_dims(t.shape, machine, batch_sizes)
-    for seg in segments:
-        best, best_r, stats = substitution_optimize(
-            seg, machine, xfers, budget=seg_budget, alpha=cfg.search_alpha,
-            mem_budget=mem_budget, cost_fn=cost_fn,
-            enable_parameter=en_param, enable_attribute=en_attr)
+
+    def _cost_pcg(g: PCG) -> SearchResult:
+        return search_graph(g, machine, beam_width=beam_width,
+                            mem_budget=mem_budget, cost_fn=cost_fn,
+                            enable_parameter=en_param,
+                            enable_attribute=en_attr, pins=g.pins)
+
+    for si, (seg, k) in enumerate(zip(segments, keys)):
+        best = best_r = None
+        if k in memo:
+            path, base_cost = memo[k]
+            replayed = replay_path(seg, xfers, path)
+            if replayed is not None:
+                try:
+                    best, best_r = replayed, _cost_pcg(replayed)
+                except (KeyError, RuntimeError):
+                    best = best_r = None
+            if best is not None:
+                stats_all.segments_replayed += 1
+                stats_all.baseline_cost += base_cost
+                stats_all.best_cost += best_r.cost
+        if best is None:
+            uniq_left = len(set(keys[si:]) - set(memo))
+            seg_budget = max(1, budget_left // max(1, uniq_left))
+            best, best_r, stats = substitution_optimize(
+                seg, machine, xfers, budget=seg_budget,
+                alpha=cfg.search_alpha, beam_width=beam_width,
+                mem_budget=mem_budget, cost_fn=cost_fn,
+                enable_parameter=en_param, enable_attribute=en_attr)
+            budget_left = max(0, budget_left - stats.expansions)
+            memo[k] = (stats.best_path, stats.baseline_cost)
+            stats_all.expansions += stats.expansions
+            stats_all.generated += stats.generated
+            stats_all.deduped += stats.deduped
+            stats_all.pruned += stats.pruned
+            stats_all.baseline_cost += stats.baseline_cost
+            stats_all.best_cost += stats.best_cost
         strategy_from_pcg(best, machine, best_r, model_layer_names,
                           model_input_names, strategy=st)
-        stats_all.expansions += stats.expansions
-        stats_all.generated += stats.generated
-        stats_all.deduped += stats.deduped
-        stats_all.pruned += stats.pruned
-        stats_all.baseline_cost += stats.baseline_cost
-        stats_all.best_cost += stats.best_cost
     st.name = (f"unity(cost={stats_all.best_cost * 1e3:.3f}ms, "
                f"x{stats_all.improvement:.2f} vs dp, "
-               f"{stats_all.expansions} expansions)")
+               f"{stats_all.expansions} expansions, "
+               f"{stats_all.segments_replayed} replayed)")
     return st, stats_all
