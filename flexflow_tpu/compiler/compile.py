@@ -163,7 +163,10 @@ class CompiledModel:
                     continue
                 d = {}
                 for i, (wname, spec) in enumerate(sorted(layer.weight_specs.items())):
-                    init = overrides.get((layer.name, wname)) or default_initializer(wname)
+                    # fork_join weights are "b{i}.{sublayer}.{wname}": the
+                    # default initializer keys off the terminal wname
+                    init = overrides.get((layer.name, wname)) or \
+                        default_initializer(wname.rsplit(".", 1)[-1])
                     # fold by topo position (not guid) so identically-built
                     # models init identically across FFModel instances
                     k = jax.random.fold_in(jax.random.fold_in(key, li), i)

@@ -67,10 +67,33 @@ def build_forward(
     if compute_dtype and compute_dtype not in ("float32", "f32", None):
         cast_to = jnp.dtype(compute_dtype)
 
+    op_attrs = {name: dict(sh.attrs)
+                for name, sh in strategy.op_shardings.items() if sh.attrs}
+
+    from flexflow_tpu.ops.op_type import OperatorType as _OT
+
+    _norm_types = (_OT.LAYERNORM, _OT.BATCHNORM)
+    # per-layer weight names exempt from the compute-dtype cast: norm params
+    # (gamma/beta) — including norms nested inside fork_join branches, whose
+    # weights surface as "b{i}.{sublayer}.{w}" on the composite layer
+    cast_exempt: Dict[str, set] = {}
+    for _l in layers:
+        if _l.op_type in _norm_types:
+            cast_exempt[_l.name] = set(_l.weight_specs)
+        elif _l.op_type is _OT.FORK_JOIN:
+            ex = set()
+            for bi, (bls, _bx, _bo) in enumerate(_l.branches):
+                for bl in bls:
+                    if bl.op_type in _norm_types:
+                        ex.update(f"b{bi}.{bl.name}.{w}" for w in bl.weight_specs)
+            if ex:
+                cast_exempt[_l.name] = ex
+
     def forward(params, state, input_arrays, training, rng):
         ctx = LoweringCtx(training=training, rng=rng, seq_length=seq_length,
                           state=dict(state),
-                          compute_dtype=str(cast_to) if cast_to else None)
+                          compute_dtype=str(cast_to) if cast_to else None,
+                          mesh=mesh, op_attrs=op_attrs)
         env: Dict[int, jax.Array] = {}
         for t, arr in zip(graph_inputs, input_arrays):
             if cast_to is not None and jnp.issubdtype(arr.dtype, jnp.floating):
@@ -78,20 +101,20 @@ def build_forward(
             if mesh is not None:
                 arr = maybe_constrain(arr, strategy.input_pspec(t.name), mesh)
             env[t.guid] = arr
-        from flexflow_tpu.ops.op_type import OperatorType
-
-        norm_types = (OperatorType.LAYERNORM, OperatorType.BATCHNORM)
         for layer in order:
             ins = [env[t.guid] for t in layer.inputs]
             w = params.get(layer.name, {})
-            if cast_to is not None and layer.op_type not in norm_types:
+            if cast_to is not None:
                 # uniform mixed-precision policy: master weights stay f32 in
                 # params/optimizer, every op computes in compute_dtype; grads
                 # flow back through the cast and accumulate in f32. Norm
                 # params (gamma/beta) are exempt — their lowerings compute the
-                # affine in f32 (standard AMP keeps norm params full precision).
+                # affine in f32 (standard AMP keeps norm params full
+                # precision) — including norms inside fork_join branches.
+                ex = cast_exempt.get(layer.name, ())
                 w = {k: (v.astype(cast_to)
-                         if jnp.issubdtype(v.dtype, jnp.floating) else v)
+                         if k not in ex and jnp.issubdtype(v.dtype, jnp.floating)
+                         else v)
                      for k, v in w.items()}
             outs = get_op_def(layer.op_type).lower(layer, ins, w, ctx)
             if mesh is not None:

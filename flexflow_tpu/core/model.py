@@ -349,6 +349,48 @@ class FFModel:
                               name=f"{name or 'moe'}_experts")
         return self.aggregate(topk_vals, topk_idx, positions, hidden, name=f"{name or 'moe'}_agg")
 
+    def fork_join(self, input: Tensor, branches, join: str = "add",
+                  name=None) -> Tensor:
+        """Inter-op placement composite: parallel branches that the search may
+        place on disjoint device subsets (reference: Unity nonsequence splits,
+        src/runtime/graph.cc:187-321; here a first-class composite like moe).
+
+        Each branch is a callable f(sub_model: FFModel, x: Tensor) -> Tensor
+        building an ordinary layer sub-graph. join: "add" sums branch
+        outputs, "concat" concatenates along the last dim. Branch weights
+        surface on this layer as "b{i}.{sub_layer}.{wname}"."""
+        subs = []
+        overrides = []
+        for bi, build in enumerate(branches):
+            bm = FFModel(self.config)
+            bx = bm.create_tensor(list(input.shape), dtype=input.spec.dtype,
+                                  name=f"_fj_b{bi}_in")
+            out = build(bm, bx)
+            # auto-generated sub-layer names embed the process-global Layer
+            # guid; rename positionally so identically-built models get
+            # identical weight keys (init determinism + name-based transfer)
+            rename = {}
+            for j, l in enumerate(bm.layers):
+                if l.name == f"{l.op_type.value}_{l.guid}":
+                    rename[l.name] = f"{l.op_type.value}{j}"
+                    l.name = rename[l.name]
+            subs.append((bm.layers, bx, out))
+            overrides.append({(rename.get(ln, ln), wn): init
+                              for (ln, wn), init in bm._initializer_overrides.items()})
+        layer = Layer(OperatorType.FORK_JOIN,
+                      {"join": join, "n_branches": len(branches)},
+                      [input], name=name)
+        layer.branches = subs
+        for i, spec in enumerate(get_op_def(OperatorType.FORK_JOIN).infer(layer)):
+            layer.add_output(spec, idx=i)
+        self.layers.append(layer)
+        # lift branch initializer overrides onto the prefixed weight names
+        for bi, ov in enumerate(overrides):
+            for (lname, wname), init in ov.items():
+                self._initializer_overrides[
+                    (layer.name, f"b{bi}.{lname}.{wname}")] = init
+        return layer.outputs[0]
+
     # parallel ops (reference: src/parallel_ops/) --------------------------
     def repartition(self, input: Tensor, dim: int, axis: str = "data", name=None) -> Tensor:
         return self._add_layer(OperatorType.REPARTITION, {"dim": dim, "axis": axis},

@@ -22,4 +22,5 @@ from flexflow_tpu.ops import (  # noqa: F401
     attention_ops,
     moe_ops,
     parallel_ops,
+    fork_join,
 )

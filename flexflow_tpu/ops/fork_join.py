@@ -1,0 +1,141 @@
+"""FORK_JOIN — the inter-op-placement composite.
+
+Reference analog: Unity's nonsequence splits place parallel PCG branches on
+disjoint machine subsets (/root/reference/src/runtime/graph.cc:187-321,
+VERTICAL/HORIZONTAL). There the split is implicit graph structure; here the
+fork-join region is a first-class op (like the reference's `moe()` composite,
+include/flexflow/model.h:509) holding one sub-graph per branch:
+
+  - built via `FFModel.fork_join(x, [branch_builder...], join=...)`;
+  - each branch is a sequence of ordinary Layers (built against a sub-model);
+  - the search chooses its placement like any other op: the `dp` candidate
+    computes every branch on every device (batch-sharded), the `inter:{axis}`
+    candidate places branch i on mesh-axis index i (disjoint chips) via
+    shard_map + lax.switch (parallel/interop.py) and pays the join collective.
+
+Weight naming: branch i's layer L weight w is exposed as "b{i}.{L}.{w}" on
+the fork_join layer, so checkpointing/get_weight/set_weight see one flat op.
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Dict, List
+
+if TYPE_CHECKING:
+    from flexflow_tpu.core.layer import Layer
+from flexflow_tpu.core.tensor import TensorSpec
+from flexflow_tpu.ops.op_type import OperatorType
+from flexflow_tpu.ops.registry import LoweringCtx, get_op_def, register_op
+
+
+def _branch_layers(layer: Layer, bi: int) -> List[Layer]:
+    return layer.branches[bi][0]
+
+
+# ops whose lowering writes LoweringCtx.new_state; their tracers cannot cross
+# the shard_map/switch boundary of the placed execution path
+_STATEFUL_OPS = frozenset({OperatorType.BATCHNORM, OperatorType.CACHE})
+
+
+def inter_placeable(layer: "Layer") -> bool:
+    """True when this fork_join can execute under inter:{axis} placement:
+    equal branch output shapes (lax.switch arms must agree) and no stateful
+    sub-ops (their new_state tracers would leak out of the shard_map)."""
+    shapes = {tuple(out.spec.shape) for (_l, _b, out) in layer.branches}
+    if len(shapes) != 1:
+        return False
+    return not any(l.op_type in _STATEFUL_OPS
+                   for (ls, _b, _o) in layer.branches for l in ls)
+
+
+def _fj_infer(layer: Layer) -> List[TensorSpec]:
+    if not hasattr(layer, "branches") or not layer.branches:
+        raise ValueError("fork_join layer has no branches attached "
+                         "(build via FFModel.fork_join)")
+    join = layer.params["join"]
+    x = layer.inputs[0].spec
+    out_specs = [out.spec for (_layers, _bx, out) in layer.branches]
+    base = out_specs[0]
+    for s in out_specs[1:]:
+        if s.ndim != base.ndim or (join == "add" and s.shape != base.shape):
+            raise ValueError(f"fork_join branch shapes differ: {out_specs}")
+        if join == "concat" and s.shape[:-1] != base.shape[:-1]:
+            raise ValueError(f"fork_join concat branches must agree on all "
+                             f"dims but the last: {out_specs}")
+    if base.shape[0] != x.shape[0]:
+        raise ValueError("fork_join branches must preserve the batch dim")
+    layer.weight_specs = {}
+    for bi, (layers, _bx, _out) in enumerate(layer.branches):
+        for l in layers:
+            for w, spec in l.weight_specs.items():
+                layer.weight_specs[f"b{bi}.{l.name}.{w}"] = spec
+    if join == "add":
+        return [base]
+    last = sum(s.shape[-1] for s in out_specs)
+    return [base.with_shape(base.shape[:-1] + (last,))]
+
+
+def _branch_weight_dicts(layer: Layer, weights: Dict) -> List[Dict[str, Dict]]:
+    """Split the flat prefixed weight dict back into per-branch
+    {sub_layer_name: {wname: array}}."""
+    out: List[Dict[str, Dict]] = []
+    for bi in range(len(layer.branches)):
+        prefix = f"b{bi}."
+        d: Dict[str, Dict] = {}
+        for k, v in weights.items():
+            if not k.startswith(prefix):
+                continue
+            lname, wname = k[len(prefix):].rsplit(".", 1)
+            d.setdefault(lname, {})[wname] = v
+        out.append(d)
+    return out
+
+
+def _make_branch_fn(layer: Layer, bi: int, ctx: LoweringCtx):
+    layers, bx, bout = layer.branches[bi]
+
+    def run(x, wdict):
+        env = {bx.guid: x}
+        for l in layers:
+            ins = [env[t.guid] for t in l.inputs]
+            outs = get_op_def(l.op_type).lower(l, ins, wdict.get(l.name, {}), ctx)
+            for t, o in zip(l.outputs, outs):
+                env[t.guid] = o
+        return env[bout.guid]
+
+    return run
+
+
+def _fj_lower(layer: Layer, inputs, weights, ctx: LoweringCtx):
+    import jax.numpy as jnp
+
+    x = inputs[0]
+    join = layer.params["join"]
+    wdicts = _branch_weight_dicts(layer, weights)
+    fns = [_make_branch_fn(layer, bi, ctx) for bi in range(len(layer.branches))]
+
+    placement = ctx.op_attrs.get(layer.name, {}).get("placement")
+    if placement and ctx.mesh is not None and placement in ctx.mesh.shape \
+            and inter_placeable(layer):
+        from flexflow_tpu.parallel.interop import place_branches
+
+        return [place_branches(ctx.mesh, placement, fns, x, wdicts, join)]
+    # replicated execution: every device runs every branch (batch-sharded)
+    ys = [fn(x, wd) for fn, wd in zip(fns, wdicts)]
+    if join == "add":
+        out = ys[0]
+        for y in ys[1:]:
+            out = out + y
+        return [out]
+    return [jnp.concatenate(ys, axis=-1)]
+
+
+def _fj_flops(layer: Layer) -> float:
+    total = 0.0
+    for bi in range(len(layer.branches)):
+        for l in _branch_layers(layer, bi):
+            total += get_op_def(l.op_type).flop_count(l)
+    return total
+
+
+register_op(OperatorType.FORK_JOIN, _fj_infer, _fj_lower, _fj_flops)

@@ -93,6 +93,9 @@ class OperatorType(enum.Enum):
     EXPERTS = "experts"
     # fused compute op (reference: src/ops/fused.cc)
     FUSED = "fused"
+    # inter-op placement composite (reference: nonsequence splits,
+    # src/runtime/graph.cc:187-321; branches on disjoint device subsets)
+    FORK_JOIN = "fork_join"
     # parallel ops (reference: src/parallel_ops/)
     REPARTITION = "repartition"
     COMBINE = "combine"
@@ -119,6 +122,7 @@ WEIGHTED_OPS = frozenset(
         OperatorType.LAYERNORM,
         OperatorType.MULTIHEAD_ATTENTION,
         OperatorType.EXPERTS,
+        OperatorType.FORK_JOIN,
     }
 )
 

@@ -42,6 +42,11 @@ class LoweringCtx:
     # non-trainable state (batch-norm running stats, cache scores):
     state: Dict[str, Any] = dataclasses.field(default_factory=dict)
     new_state: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    # placement channel (strategy -> lowering): the device mesh and per-op
+    # strategy attributes (e.g. fork_join's {"placement": axis} for inter-op
+    # placement on disjoint device subsets)
+    mesh: Optional[Any] = None
+    op_attrs: Dict[str, Dict[str, Any]] = dataclasses.field(default_factory=dict)
 
     def rng_for(self, layer: Layer) -> jax.Array:
         if self.rng is None:

@@ -54,10 +54,13 @@ def used_axes(dims: Sequence[DimSharding]):
 
 @dataclasses.dataclass
 class OpSharding:
-    """Per-op placement: output and weight dim shardings."""
+    """Per-op placement: output and weight dim shardings, plus free-form
+    placement attributes (e.g. fork_join's {"placement": axis} selecting
+    inter-op placement — reference nonsequence splits, graph.cc:187-321)."""
 
     outputs: List[List[DimSharding]] = dataclasses.field(default_factory=list)
     weights: Dict[str, List[DimSharding]] = dataclasses.field(default_factory=dict)
+    attrs: Dict[str, str] = dataclasses.field(default_factory=dict)
 
     def output_pspec(self, idx: int = 0) -> PartitionSpec:
         if idx >= len(self.outputs):
@@ -70,13 +73,17 @@ class OpSharding:
         return dims_to_pspec(self.weights[name])
 
     def to_json(self):
-        return {"outputs": self.outputs, "weights": self.weights}
+        d = {"outputs": self.outputs, "weights": self.weights}
+        if self.attrs:
+            d["attrs"] = self.attrs
+        return d
 
     @staticmethod
     def from_json(d) -> "OpSharding":
         return OpSharding(
             outputs=[[_norm_dim(x) for x in o] for o in d.get("outputs", [])],
             weights={k: [_norm_dim(x) for x in v] for k, v in d.get("weights", {}).items()},
+            attrs=dict(d.get("attrs", {})),
         )
 
     def __str__(self):

@@ -47,6 +47,10 @@ class Candidate:
     compute_degree: int = 1
     extra_comm: float = 0.0  # collectives inherent to this placement (s)
     eff: float = 1.0  # MXU-tile granularity efficiency (shards < 128 lanes waste MXU)
+    # fraction of the (per-device) weight bytes actually STREAMED from HBM
+    # each step: < 1 when a device touches only part of the resident weights
+    # (fork_join inter placement runs one branch's weights per device)
+    weight_stream_frac: float = 1.0
     # passthrough: identity layout op — adopts whatever layout arrives (minus
     # drop_axis) with zero cost. Used by engine-inserted Replicate/Reduction
     # marker nodes so they never force a gather of the batch sharding.
@@ -59,8 +63,9 @@ class Candidate:
         # weights stream in full per replica (each device reads its own shard)
         act_bytes = (sum(i.spec.size_bytes for i in layer.inputs)
                      + sum(o.spec.size_bytes for o in layer.outputs))
-        w_bytes = sum(cm.shard_bytes(s, self.weight_dims.get(w, []), machine)
-                      for w, s in layer.weight_specs.items())
+        w_bytes = self.weight_stream_frac * sum(
+            cm.shard_bytes(s, self.weight_dims.get(w, []), machine)
+            for w, s in layer.weight_specs.items())
         deg = max(1.0, self.compute_degree * self.eff)
         hbm = act_bytes / deg + w_bytes
         t = cm.compute_time(od.flop_count(layer), hbm, machine, deg,
@@ -77,6 +82,14 @@ class Candidate:
         for w, spec in layer.weight_specs.items():
             m += 4 * cm.shard_bytes(spec, self.weight_dims.get(w, []), machine)
         return m
+
+
+def candidate_attrs(cand: "Candidate") -> Dict[str, str]:
+    """Strategy attrs a chosen candidate implies (consumed by the lowering
+    via LoweringCtx.op_attrs): inter:{axis} -> fork_join branch placement."""
+    if cand.name.startswith("inter:"):
+        return {"placement": cand.name.split(":", 1)[1]}
+    return {}
 
 
 def _batch_axes(machine: MachineSpec) -> List[str]:
@@ -226,6 +239,33 @@ def layer_candidates(layer: "Layer", machine: MachineSpec, batch_sizes,
                 cands.append(Candidate(
                     f"tp_oc:{m}", dp_in, od, wd,
                     compute_degree=max(1, dp.compute_degree) * dm))
+
+    elif t is OperatorType.FORK_JOIN:
+        # inter-op placement (reference nonsequence splits, graph.cc:187-321):
+        # branch i on mesh-axis index i. Compute divides by the axis size
+        # (balanced branches run concurrently on disjoint chips); the join
+        # collective (psum for add, all_gather for concat) is the price.
+        # The dp candidate computes every branch on every device instead.
+        k = layer.params["n_branches"]
+        join = layer.params["join"]
+        # switch-based placement stacks branch outputs: all branch shapes
+        # must be equal, and stateful sub-ops (batch_norm running stats,
+        # cache) cannot thread state through the shard_map body
+        from flexflow_tpu.ops.fork_join import inter_placeable
+
+        if not inter_placeable(layer):
+            return cands
+        for m in maxes:
+            if machine.mesh_axes[m] != k:
+                continue
+            out_bytes = cm.shard_bytes(ospecs[0], dp_out[0], machine)
+            comm = (cm.all_reduce_time(out_bytes, (m,), machine) if join == "add"
+                    else cm.all_gather_time(out_bytes, (m,), machine))
+            cands.append(Candidate(
+                f"inter:{m}", dp_in, dp_out, dict(repl_w),
+                compute_degree=max(1, dp.compute_degree) * k,
+                extra_comm=comm,
+                weight_stream_frac=1.0 / k))
 
     elif t in UNARY_OPS or t in (OperatorType.DROPOUT, OperatorType.CAST,
                                  OperatorType.SOFTMAX, OperatorType.LOG_SOFTMAX):

@@ -23,11 +23,14 @@ def result_to_strategy(model, machine: MachineSpec, result: SearchResult) -> Str
     batch_sizes = {t.shape[0] for t in model.input_tensors if t.ndim > 0}
     for t in model.input_tensors:
         st.input_shardings[t.name] = _dp_dims(t.shape, machine, batch_sizes)
+    from flexflow_tpu.search.candidates import candidate_attrs
+
     for layer in topo_order(model.layers):
         cand = result.choices[layer.name]
         st.op_shardings[layer.name] = OpSharding(
             outputs=[list(d) for d in cand.out_dims],
             weights={w: list(d) for w, d in cand.weight_dims.items()},
+            attrs=candidate_attrs(cand),
         )
     return st
 

@@ -24,7 +24,7 @@ from flexflow_tpu.core.graph import topo_order
 from flexflow_tpu.ops.op_type import PARALLEL_OPS, OperatorType
 from flexflow_tpu.parallel.machine import MachineSpec
 from flexflow_tpu.parallel.sharding import OpSharding, Strategy
-from flexflow_tpu.search.candidates import _dp_dims
+from flexflow_tpu.search.candidates import _dp_dims, candidate_attrs
 from flexflow_tpu.search.dp import SearchResult, _drop_axis, _freeze_dims, search_graph
 from flexflow_tpu.search.pcg import PCG
 from flexflow_tpu.search.substitution import (
@@ -251,6 +251,7 @@ def strategy_from_pcg(pcg: PCG, machine: MachineSpec, result: SearchResult,
             st.op_shardings[layer.name] = OpSharding(
                 outputs=[[_unfreeze(d) for d in lay[o.guid]] for o in layer.outputs],
                 weights={w: list(d) for w, d in cand.weight_dims.items()},
+                attrs=candidate_attrs(cand),
             )
         else:
             inserted.append(layer)

@@ -1,0 +1,219 @@
+"""Inter-op (branch) placement — P8, the Unity nonsequence-split analog
+(reference src/runtime/graph.cc:187-321): branches of a fork-join region run
+on disjoint device subsets via shard_map + lax.switch, the search chooses
+that placement when the cost model favors it, and the placed execution
+matches the sequential numerics."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from flexflow_tpu import FFConfig, FFModel, SGDOptimizer
+from flexflow_tpu.parallel.interop import place_branches
+from flexflow_tpu.parallel.machine import MachineSpec, build_mesh
+from flexflow_tpu.search.dp import search_graph
+
+MACH = MachineSpec(mesh_axes={"data": 4, "model": 2}, chip="v5p")
+
+
+# ----------------------------------------------------------- the mechanism
+def _mk_branches():
+    def b0(x, w):
+        return jnp.tanh(x @ w["w0"])
+
+    def b1(x, w):
+        return jax.nn.relu(x @ w["w1"]) * 2.0
+
+    rng = np.random.default_rng(0)
+    w0 = {"w0": jnp.asarray(rng.normal(size=(16, 8)), jnp.float32)}
+    w1 = {"w1": jnp.asarray(rng.normal(size=(16, 8)), jnp.float32)}
+    x = jnp.asarray(rng.normal(size=(8, 16)), jnp.float32)
+    return [b0, b1], [w0, w1], x
+
+
+def test_place_branches_matches_sequential(devices):
+    mesh = build_mesh(MACH)
+    fns, ws, x = _mk_branches()
+    placed = place_branches(mesh, "model", fns, x, ws, "add")
+    seq = fns[0](x, ws[0]) + fns[1](x, ws[1])
+    np.testing.assert_allclose(np.asarray(placed), np.asarray(seq), rtol=2e-6)
+
+    cat = place_branches(mesh, "model", fns, x, ws, "concat")
+    seq_cat = jnp.concatenate([fns[0](x, ws[0]), fns[1](x, ws[1])], axis=-1)
+    np.testing.assert_allclose(np.asarray(cat), np.asarray(seq_cat), rtol=2e-6)
+
+
+def test_place_branches_gradients(devices):
+    """shard_map transpose + switch must give each branch weight the same
+    gradient as sequential execution (the disjoint groups' contributions
+    psum back correctly)."""
+    mesh = build_mesh(MACH)
+    fns, ws, x = _mk_branches()
+
+    def loss_placed(ws_):
+        return jnp.sum(place_branches(mesh, "model", fns, x, ws_, "add") ** 2)
+
+    def loss_seq(ws_):
+        return jnp.sum((fns[0](x, ws_[0]) + fns[1](x, ws_[1])) ** 2)
+
+    gp = jax.grad(loss_placed)(ws)
+    gs = jax.grad(loss_seq)(ws)
+    np.testing.assert_allclose(np.asarray(gp[0]["w0"]), np.asarray(gs[0]["w0"]),
+                               rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(gp[1]["w1"]), np.asarray(gs[1]["w1"]),
+                               rtol=1e-4)
+
+
+def test_place_branches_rejects_bad_axis(devices):
+    mesh = build_mesh(MACH)
+    fns, ws, x = _mk_branches()
+    with pytest.raises(ValueError):
+        place_branches(mesh, "data", fns, x, ws, "add")  # size 4 != 2 branches
+    with pytest.raises(ValueError):
+        place_branches(mesh, "nope", fns, x, ws, "add")
+
+
+# ------------------------------------------------------------ the fork_join op
+def _branch_builder(hidden, act):
+    def build(m, x):
+        h = m.dense(x, hidden, activation=act, name="mid")
+        return m.dense(h, 64, name="out")
+    return build
+
+
+def _fat_model(hidden=2048):
+    m = FFModel(FFConfig(batch_size=32, mesh_shape={"data": 4, "model": 2}))
+    x = m.create_tensor([32, 64], name="x")
+    m.fork_join(x, [_branch_builder(hidden, "relu"),
+                    _branch_builder(hidden, "gelu")], join="add", name="fj")
+    return m
+
+
+def test_fork_join_infer_and_weights():
+    m = _fat_model()
+    fj = m.get_layer_by_name("fj")
+    assert fj.outputs[0].spec.shape == (32, 64)
+    assert "b0.mid.kernel" in fj.weight_specs
+    assert fj.weight_specs["b1.out.kernel"].shape == (2048, 64)
+
+
+def test_search_places_fat_branches_on_disjoint_chips():
+    """The nonsequence-split decision: with fat branches the cost model must
+    prefer inter:model (each branch on half the chips) over replicated
+    execution; with tiny branches the join collective dominates and dp wins."""
+    fat = _fat_model(hidden=4096)
+    r = search_graph(fat, MACH)
+    assert r.choices["fj"].name == "inter:model", r.choices["fj"].name
+
+    thin = _fat_model(hidden=8)
+    r2 = search_graph(thin, MACH)
+    assert r2.choices["fj"].name == "dp", r2.choices["fj"].name
+
+
+def test_fork_join_trains_placed_and_matches_sequential(devices):
+    """End-to-end P8 'done' bar: the search selects inter-op placement, the
+    model trains on the mesh with branches on disjoint chips, and the placed
+    forward matches the replicated lowering numerically."""
+    cfg = FFConfig(batch_size=32, mesh_shape={"data": 4, "model": 2},
+                   search_budget=8)
+    m = FFModel(cfg)
+    x = m.create_tensor([32, 64], name="x")
+    m.fork_join(x, [_branch_builder(512, "relu"),
+                    _branch_builder(512, "gelu")], join="add", name="fj")
+    cm = m.compile(SGDOptimizer(lr=0.01), loss_type="mean_squared_error",
+                   metrics=[])
+    sh = cm.strategy.op_shardings.get("fj")
+    assert sh is not None and sh.attrs.get("placement") == "model", \
+        (sh and sh.attrs, cm.strategy.name)
+    cm.init(seed=0)
+
+    rng = np.random.default_rng(0)
+    xv = rng.normal(size=(32, 64)).astype(np.float32)
+    yv = rng.normal(size=(32, 64)).astype(np.float32)
+
+    # placed forward == replicated forward (same weights, no placement attr)
+    placed_out = np.asarray(cm.forward(xv))
+    cfg2 = FFConfig(batch_size=32, mesh_shape={"data": 4, "model": 2},
+                    only_data_parallel=True)
+    m2 = FFModel(cfg2)
+    x2 = m2.create_tensor([32, 64], name="x")
+    m2.fork_join(x2, [_branch_builder(512, "relu"),
+                      _branch_builder(512, "gelu")], join="add", name="fj")
+    cm2 = m2.compile(SGDOptimizer(lr=0.01), loss_type="mean_squared_error",
+                     metrics=[])
+    assert not cm2.strategy.sharding_for("fj").attrs  # replicated execution
+    cm2.init(seed=0)
+    cm2.set_weight("fj", "b0.mid.kernel", cm.get_weight("fj", "b0.mid.kernel"))
+    for w in cm.params["fj"]:
+        cm2.set_weight("fj", w, cm.get_weight("fj", w))
+    repl_out = np.asarray(cm2.forward(xv))
+    np.testing.assert_allclose(placed_out, repl_out, rtol=2e-5, atol=2e-5)
+
+    # trains: one epoch, finite and decreasing loss
+    h = cm.fit(xv, yv, epochs=3, verbose=False)
+    assert np.isfinite(h[-1]["loss"])
+    assert h[-1]["loss"] <= h[0]["loss"] * 1.01
+
+    # the ParallelTensor view reflects replicated branch weights on the mesh
+    wv = cm.weight_view("fj", "b0.mid.kernel")
+    assert wv.shard_shape == (64, 512), wv
+    assert "model" in wv.replica_axes
+
+
+def test_inter_gated_for_ragged_and_stateful_branches():
+    """lax.switch arms must agree on shapes and cannot thread new_state:
+    such fork_joins never get the inter candidate (they run replicated)."""
+    from flexflow_tpu.search.candidates import layer_candidates
+
+    m = FFModel(FFConfig(batch_size=16, mesh_shape={"data": 4, "model": 2}))
+    x = m.create_tensor([16, 32], name="x")
+    m.fork_join(x, [lambda mm, t: mm.dense(t, 8, name="a"),
+                    lambda mm, t: mm.dense(t, 4, name="b")],
+                join="concat", name="ragged")
+    cands = layer_candidates(m.get_layer_by_name("ragged"), MACH, {16})
+    assert [c.name for c in cands] == ["dp"]
+
+    m2 = FFModel(FFConfig(batch_size=16, mesh_shape={"data": 4, "model": 2}))
+    x2 = m2.create_tensor([16, 3, 8, 8], name="x")
+
+    def bn_branch(mm, t):
+        h = mm.conv2d(t, 8, 3, 3, padding_h=1, padding_w=1, name="c")
+        return mm.batch_norm(h, relu=False, name="bn")
+
+    m2.fork_join(x2, [bn_branch, bn_branch], join="add", name="stateful")
+    cands2 = layer_candidates(m2.get_layer_by_name("stateful"), MACH, {16})
+    assert [c.name for c in cands2] == ["dp"]
+
+
+def test_fork_join_weight_keys_deterministic_across_instances():
+    """Auto-named branch sub-layers must not leak process-global guids into
+    weight keys (init determinism + name-based weight transfer)."""
+    def build():
+        m = FFModel(FFConfig(batch_size=8))
+        x = m.create_tensor([8, 16], name="x")
+        m.fork_join(x, [lambda mm, t: mm.dense(mm.relu(mm.dense(t, 32)), 16),
+                        lambda mm, t: mm.dense(t, 16)], join="add", name="fj")
+        return m
+
+    k1 = sorted(build().get_layer_by_name("fj").weight_specs)
+    k2 = sorted(build().get_layer_by_name("fj").weight_specs)
+    assert k1 == k2, (k1, k2)
+    assert all(".linear" in k or ".mid" in k or ".out" in k for k in k1), k1
+
+
+def test_fork_join_concat_join(devices):
+    cfg = FFConfig(batch_size=16, mesh_shape={"data": 4, "model": 2},
+                   only_data_parallel=True)
+    m = FFModel(cfg)
+    x = m.create_tensor([16, 32], name="x")
+    m.fork_join(x, [_branch_builder(64, "relu"),
+                    _branch_builder(64, None)], join="concat", name="fj")
+    fj = m.get_layer_by_name("fj")
+    assert fj.outputs[0].spec.shape == (16, 128)
+    cm = m.compile(SGDOptimizer(lr=0.01), loss_type="mean_squared_error",
+                   metrics=[])
+    cm.init(seed=0)
+    rng = np.random.default_rng(1)
+    out = cm.forward(rng.normal(size=(16, 32)).astype(np.float32))
+    assert np.asarray(out).shape == (16, 128)
