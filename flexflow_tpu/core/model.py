@@ -162,6 +162,9 @@ class FFModel:
     def gelu(self, input, name=None):
         return self._unary(OperatorType.GELU, input, name)
 
+    def erf(self, input, name=None):
+        return self._unary(OperatorType.ERF, input, name)
+
     def silu(self, input, name=None):
         return self._unary(OperatorType.SILU, input, name)
 

@@ -1,0 +1,3 @@
+"""ONNX frontend (reference analog: python/flexflow/onnx/)."""
+
+from flexflow_tpu.onnx.model import ONNXModel  # noqa: F401

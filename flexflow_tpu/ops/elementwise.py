@@ -33,6 +33,7 @@ _UNARY_FNS = {
     OperatorType.SQRT: jnp.sqrt,
     OperatorType.RSQRT: jax.lax.rsqrt,
     OperatorType.SILU: jax.nn.silu,
+    OperatorType.ERF: jax.lax.erf,
 }
 
 

@@ -41,8 +41,9 @@ def inter_placeable(layer: "Layer") -> bool:
     """True when this fork_join can execute under inter:{axis} placement:
     equal branch output shapes (lax.switch arms must agree) and no stateful
     sub-ops (their new_state tracers would leak out of the shard_map)."""
-    shapes = {tuple(out.spec.shape) for (_l, _b, out) in layer.branches}
-    if len(shapes) != 1:
+    sigs = {(tuple(out.spec.shape), out.spec.dtype)
+            for (_l, _b, out) in layer.branches}
+    if len(sigs) != 1:
         return False
     return not any(l.op_type in _STATEFUL_OPS
                    for (ls, _b, _o) in layer.branches for l in ls)

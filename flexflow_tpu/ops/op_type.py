@@ -55,6 +55,7 @@ class OperatorType(enum.Enum):
     RSQRT = "rsqrt"
     POW = "pow"
     SILU = "silu"
+    ERF = "erf"
     # shape / movement
     RESHAPE = "reshape"
     TRANSPOSE = "transpose"
@@ -143,6 +144,7 @@ UNARY_OPS = frozenset(
         OperatorType.RSQRT,
         OperatorType.POW,
         OperatorType.SILU,
+        OperatorType.ERF,
         OperatorType.SCALAR_MULTIPLY,
         OperatorType.SCALAR_ADD,
         OperatorType.SCALAR_SUB,

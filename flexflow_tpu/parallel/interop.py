@@ -131,8 +131,7 @@ def place_branches(
 
     bwd_sm = shard_map(_bwd_body, mesh=mesh,
                        in_specs=(x_spec, stk_spec) + w_specs,
-                       out_specs=(x_spec,
-                                  jax.tree_util.tree_map(lambda s: s, w_specs)))
+                       out_specs=(x_spec, w_specs))
 
     @jax.custom_vjp
     def run(x_, ws_):
