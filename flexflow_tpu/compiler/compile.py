@@ -39,11 +39,26 @@ from flexflow_tpu.parallel.sharding import Strategy
 from flexflow_tpu.runtime.dataloader import SingleDataLoader, prefetch_to_device
 
 
+def _search_machine(cfg, machine: MachineSpec) -> MachineSpec:
+    """--search-num-nodes/--search-num-workers (reference config.h:154-155):
+    search strategies for a machine LARGER than the real one (typically with
+    --export, so big-machine strategies can be found on a small host). Nodes
+    map to a DCN-crossing data axis, workers to the intra-node model axis."""
+    if not cfg.search_num_nodes and not cfg.search_num_workers:
+        return machine
+    nodes = max(1, cfg.search_num_nodes)
+    workers = max(1, cfg.search_num_workers)
+    axes = {"data": nodes, "model": workers}
+    return MachineSpec(mesh_axes=axes, chip=machine.chip,
+                       dcn_axes=("data",) if nodes > 1 else ())
+
+
 def _pick_strategy(model, machine: MachineSpec) -> Strategy:
     cfg = model.config
     if cfg.import_strategy_file:
         return Strategy.load(cfg.import_strategy_file)
-    if cfg.search_budget > 0 and not cfg.only_data_parallel and machine.num_devices > 1:
+    sm = _search_machine(cfg, machine)
+    if cfg.search_budget > 0 and not cfg.only_data_parallel and sm.num_devices > 1:
         try:
             from flexflow_tpu.search.optimize import graph_optimize
         except ImportError:
@@ -51,7 +66,7 @@ def _pick_strategy(model, machine: MachineSpec) -> Strategy:
 
             warnings.warn("strategy search unavailable; falling back to data-parallel")
         else:
-            return graph_optimize(model, machine)
+            return graph_optimize(model, sm)
     return data_parallel_strategy(model, machine)
 
 
@@ -116,7 +131,8 @@ class CompiledModel:
 
         self.forward_fn = build_forward(model.layers, model.input_tensors, outputs,
                                         mesh, strategy,
-                                        compute_dtype=self.cfg.compute_dtype)
+                                        compute_dtype=self.cfg.compute_dtype,
+                                        enable_fusion=self.cfg.enable_fusion)
         self._build_steps()
         self.params = None
         self.state: Dict[str, Any] = {}
@@ -140,6 +156,17 @@ class CompiledModel:
         if label_shape and label_shape[0] % self.mesh.shape[ax] == 0:
             return NamedSharding(self.mesh, PartitionSpec(ax))
         return NamedSharding(self.mesh, PartitionSpec())
+
+    def _put(self, arr, sharding):
+        """Host→device transfer for EVERY data path (fit/evaluate/forward/
+        set_weight). Single-process: plain device_put. Multi-process
+        (control-replication analog): every process holds the full host
+        array and contributes the rows its addressable shards own."""
+        if jax.process_count() == 1:
+            return jax.device_put(arr, sharding)
+        from flexflow_tpu.runtime.distributed import global_batch_from_full
+
+        return global_batch_from_full(np.asarray(arr), self.mesh, sharding.spec)
 
     # ---------------------------------------------------------------- init
     def init(self, seed: Optional[int] = None):
@@ -242,11 +269,37 @@ class CompiledModel:
         lab_sh = self.label_sharding((batch_size,) + tuple(np.asarray(y).shape[1:]))
         base_rng = jax.random.PRNGKey(self.cfg.seed + 17)
         history = []
+        # --profiling (reference config.h:126): capture an xplane trace of
+        # the whole fit (the Legion-trace/profiler analog, flexflow_c.cc:1747)
+        prof_ctx = None
+        if self.cfg.profiling:
+            import os
+
+            pdir = self.cfg.profile_dir or "./ff_profile"
+            os.makedirs(pdir, exist_ok=True)
+            prof_ctx = jax.profiler.trace(pdir)
+            prof_ctx.__enter__()
+        try:
+            history = self._fit_epochs(epochs, loader, in_sh, lab_sh,
+                                       base_rng, batch_size, callbacks, verbose)
+        finally:
+            if prof_ctx is not None:
+                prof_ctx.__exit__(None, None, None)
+                if verbose:
+                    print(f"[profiling] trace written to "
+                          f"{self.cfg.profile_dir or './ff_profile'}")
+                    self.profile_report()
+        return history
+
+    def _fit_epochs(self, epochs, loader, in_sh, lab_sh, base_rng,
+                    batch_size, callbacks, verbose):
+        history = []
         for epoch in range(epochs):
             pm = PerfMetrics()
             t0 = time.perf_counter()
             loss_sum, nb = 0.0, 0
-            for dx, dy in prefetch_to_device(loader.epoch(), in_sh, lab_sh):
+            for dx, dy in prefetch_to_device(loader.epoch(), in_sh, lab_sh,
+                                             put=self._put):
                 rng = jax.random.fold_in(base_rng, self._iteration)
                 self.params, self.opt_state, self.state, loss, mvals = self.train_step(
                     self.params, self.opt_state, self.state, dx, dy, rng)
@@ -280,7 +333,8 @@ class CompiledModel:
         lab_sh = self.label_sharding((batch_size,) + tuple(np.asarray(y).shape[1:]))
         pm = PerfMetrics()
         total_loss, nb = 0.0, 0
-        for dx, dy in prefetch_to_device(loader.epoch(), in_sh, lab_sh):
+        for dx, dy in prefetch_to_device(loader.epoch(), in_sh, lab_sh,
+                                         put=self._put):
             loss, mvals = self.eval_step(self.params, self.state, dx, dy)
             pm.update(batch_size, {k: float(v) for k, v in mvals.items()})
             total_loss += float(loss)
@@ -292,10 +346,43 @@ class CompiledModel:
     def forward(self, *inputs):
         if self.params is None:
             self.init()
-        arrs = [jax.device_put(np.asarray(a), s)
+        arrs = [self._put(np.asarray(a), s)
                 for a, s in zip(inputs, [self.input_sharding(t) for t in self.model.input_tensors])]
         outs = self.infer_step(self.params, self.state, arrs)
         return outs[0] if len(outs) == 1 else outs
+
+    # ------------------------------------------------------------ profiling
+    def profile_report(self, top: int = 0, print_table: bool = True):
+        """Per-op timing table (reference: per-kernel ms prints behind
+        --profiling, src/ops/kernels/linear_kernels.cu:98-117): each layer's
+        analytic roofline prediction and isolated measured time under its
+        compiled sharding's nearest candidate. Returns the rows."""
+        from flexflow_tpu.search.dp import search_graph
+        from flexflow_tpu.search.measure import MeasuredCost
+
+        r = search_graph(self.model, self.machine, enable_parameter=False,
+                         enable_attribute=False)
+        mc = MeasuredCost(self.machine, repeats=3, warmup=1)
+        rows = []
+        for layer in self.model.layers:
+            cand = r.choices[layer.name]
+            rows.append({
+                "layer": layer.name,
+                "op": layer.op_type.value,
+                "analytic_us": cand.op_time(layer, self.machine) * 1e6,
+                "measured_us": mc.op_time(layer, cand) * 1e6,
+            })
+        rows.sort(key=lambda x: -x["measured_us"])
+        if top:
+            rows = rows[:top]
+        if print_table:
+            total = sum(x["measured_us"] for x in rows) or 1.0
+            print(f"{'layer':28} {'op':18} {'analytic':>10} {'measured':>10} {'%':>5}")
+            for x in rows:
+                print(f"{x['layer'][:28]:28} {x['op'][:18]:18} "
+                      f"{x['analytic_us']:9.1f}u {x['measured_us']:9.1f}u "
+                      f"{100 * x['measured_us'] / total:4.1f}%")
+        return rows
 
     # ------------------------------------------------- recompile-on-condition
     def recompile_on_condition(self, trigger_fn, alter_fn):
@@ -311,7 +398,8 @@ class CompiledModel:
             alter(self)
             self.forward_fn = build_forward(self.model.layers, self.model.input_tensors,
                                             self.outputs, self.mesh, self.strategy,
-                                            compute_dtype=self.cfg.compute_dtype)
+                                            compute_dtype=self.cfg.compute_dtype,
+                                            enable_fusion=self.cfg.enable_fusion)
             self._build_steps()
 
     # ------------------------------------------------------------- weights
@@ -341,7 +429,7 @@ class CompiledModel:
         return np.asarray(self.params[layer_name][wname])
 
     def set_weight(self, layer_name: str, wname: str, value):
-        value = jnp.asarray(value)
+        value = np.asarray(value)
         target = self.params[layer_name][wname]
-        assert value.shape == target.shape, (value.shape, target.shape)
-        self.params[layer_name][wname] = jax.device_put(value, target.sharding)
+        assert value.shape == tuple(target.shape), (value.shape, target.shape)
+        self.params[layer_name][wname] = self._put(value, target.sharding)

@@ -57,6 +57,7 @@ def build_forward(
     strategy: Strategy,
     seq_length: Optional[int] = None,
     compute_dtype: Optional[str] = None,
+    enable_fusion: bool = True,
 ) -> Callable:
     """Returns forward(params, state, input_arrays, training, rng)
     -> (output_arrays, new_state)."""
@@ -93,7 +94,8 @@ def build_forward(
         ctx = LoweringCtx(training=training, rng=rng, seq_length=seq_length,
                           state=dict(state),
                           compute_dtype=str(cast_to) if cast_to else None,
-                          mesh=mesh, op_attrs=op_attrs)
+                          mesh=mesh, op_attrs=op_attrs,
+                          enable_fusion=enable_fusion)
         env: Dict[int, jax.Array] = {}
         for t, arr in zip(graph_inputs, input_arrays):
             if cast_to is not None and jnp.issubdtype(arr.dtype, jnp.floating):

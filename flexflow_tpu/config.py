@@ -45,6 +45,7 @@ class FFConfig:
     # execution
     enable_fusion: bool = True
     profiling: bool = False
+    profile_dir: str = ""  # xplane trace output dir ("" = ./ff_profile)
     allow_tensor_op_math_conversion: bool = True  # = bf16 matmul policy
     compute_dtype: str = "float32"  # params dtype; "bfloat16" enables mixed policy
     remat: bool = False  # jax.checkpoint the forward for memory
@@ -95,6 +96,7 @@ class FFConfig:
         p.add_argument("--fusion", dest="fusion", action="store_true", default=True)
         p.add_argument("--no-fusion", dest="fusion", action="store_false")
         p.add_argument("--profiling", action="store_true")
+        p.add_argument("--profile-dir", type=str, default="")
         p.add_argument("--compute-dtype", type=str, default="float32")
         p.add_argument("--remat", action="store_true")
         p.add_argument("--compgraph", dest="export_dot", type=str, default="")
@@ -131,6 +133,7 @@ class FFConfig:
             machine_model_file=args.machine_model_file,
             enable_fusion=args.fusion,
             profiling=args.profiling,
+            profile_dir=args.profile_dir,
             compute_dtype=args.compute_dtype,
             remat=args.remat,
             export_dot=args.export_dot,

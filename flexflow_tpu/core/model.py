@@ -467,9 +467,23 @@ class FFModel:
     def set_parameter_by_name(self, layer_name: str, wname: str, value: np.ndarray):
         self.compiled.set_weight(layer_name, wname, value)
 
-    def dot(self) -> str:
+    def dot(self, include_costs: Optional[bool] = None) -> str:
+        """Graphviz export with sharding annotations; include_costs (the
+        --include-costs-dot-graph flag, reference model.cc:3666-3676) adds
+        each op's predicted roofline time on the compiled machine."""
+        if include_costs is None:
+            include_costs = self.config.include_costs_dot_graph
         ann = {}
         if self._compiled is not None:
             ann = {l: str(self._compiled.strategy.op_shardings.get(l.name, ""))
                    for l in self.layers}
+            if include_costs:
+                from flexflow_tpu.ops.registry import io_bytes
+                from flexflow_tpu.search import cost_model as cm_
+
+                machine = self._compiled.machine
+                for l in self.layers:
+                    t = cm_.compute_time(get_op_def(l.op_type).flop_count(l),
+                                         io_bytes(l), machine)
+                    ann[l] = (ann.get(l, "") + f"\\n{t * 1e6:.1f}us").lstrip("\\n")
         return to_dot(topo_order(self.layers), ann)

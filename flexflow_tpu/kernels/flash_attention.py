@@ -30,8 +30,18 @@ _NEG_INF = float("-inf")
 # k/v (fwd/dq) and q/do (dk/dv) are held fully in VMEM per (b, h) grid step;
 # cap their footprint well under the ~16MB VMEM budget so Mosaic never OOMs
 # on shapes that pass the divisibility checks. Longer sequences belong to the
-# ring-attention path.
+# ring-attention path (kernels/ring_attention.py).
 _VMEM_SEQ_BYTES = 6 * 1024 * 1024
+
+
+def flash_supported(seq: int, depth: int, itemsize: int = 4) -> bool:
+    """Whether the fused kernel covers this shape (block divisibility +
+    the VMEM-resident k/v budget). Beyond it, attention either falls back
+    to materializing full logits or goes sequence-parallel via the ring
+    path — the search uses this to price that choice."""
+    if any(seq % b == 0 for b in _BLOCK_CANDIDATES):
+        return 2 * seq * depth * itemsize <= _VMEM_SEQ_BYTES
+    return False
 
 
 def _pick_block(s: int) -> int:

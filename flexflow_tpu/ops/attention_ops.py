@@ -100,10 +100,24 @@ def _mha_lower(layer: Layer, inputs, weights, ctx: LoweringCtx):
     # flash kernel has no probs-dropout path: fall back (or fail under
     # impl="flash") rather than silently dropping the dropout mask
     needs_dropout = ctx.training and p.get("dropout", 0.0) > 0.0
+    # sequence parallelism: the searched strategy may place this attention
+    # on the ring path (sp_ring candidate -> {"seq_parallel": axis} attr)
+    sp_axis = ctx.op_attrs.get(layer.name, {}).get("seq_parallel")
+    if sp_axis and ctx.mesh is not None and sp_axis in ctx.mesh.shape \
+            and impl != "xla" and qh.shape[1] == kh.shape[1] == vh.shape[1] \
+            and not needs_dropout and "bias_k" not in weights \
+            and not p.get("add_zero_attn", False):
+        from flexflow_tpu.kernels.ring_attention import ring_attention_qkv
+
+        out = ring_attention_qkv(qh, kh, vh, ctx.mesh, sp_axis,
+                                 causal=causal, scale=scale)
     if impl == "flash" and needs_dropout:
         raise NotImplementedError("impl='flash' does not support attention-prob "
                                   "dropout; use dropout=0.0 or impl='xla'")
-    if impl in ("auto", "flash") and not needs_dropout:
+    # "auto" uses the fused pallas kernel only when fusion is enabled
+    # (--fusion, reference FusedOp gate); impl="flash" forces it regardless
+    if out is None and not needs_dropout and (
+            impl == "flash" or (impl == "auto" and ctx.enable_fusion)):
         try:
             from flexflow_tpu.kernels.flash_attention import flash_attention_qkv
 

@@ -47,6 +47,9 @@ class LoweringCtx:
     # placement on disjoint device subsets)
     mesh: Optional[Any] = None
     op_attrs: Dict[str, Dict[str, Any]] = dataclasses.field(default_factory=dict)
+    # --fusion flag (reference FusedOp gate, model.cc apply_fusion): False
+    # disables fused custom kernels (pallas flash attention) in "auto" mode
+    enable_fusion: bool = True
 
     def rng_for(self, layer: Layer) -> jax.Array:
         if self.rng is None:

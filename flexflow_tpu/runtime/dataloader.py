@@ -62,17 +62,22 @@ class SingleDataLoader:
             yield [self._take(x, idx) for x in self.xs], self._take(self.y, idx)
 
 
-def prefetch_to_device(it, input_shardings, label_sharding, depth: int = 2):
-    """Overlap host→device transfer with compute (double buffering)."""
+def prefetch_to_device(it, input_shardings, label_sharding, depth: int = 2,
+                       put=None):
+    """Overlap host→device transfer with compute (double buffering).
+    `put(arr, sharding)` overrides the transfer (multi-host runs pass the
+    global-array assembler from runtime/distributed.py)."""
     q: "queue_mod.Queue" = queue_mod.Queue(maxsize=depth)
     _DONE = object()
+    if put is None:
+        put = jax.device_put
 
     def worker():
         try:
             for xs, y in it:
-                dx = [jax.device_put(x, s) if s is not None else jax.device_put(x)
+                dx = [put(x, s) if s is not None else jax.device_put(x)
                       for x, s in zip(xs, input_shardings)]
-                dy = jax.device_put(y, label_sharding) if label_sharding is not None else jax.device_put(y)
+                dy = put(y, label_sharding) if label_sharding is not None else jax.device_put(y)
                 q.put((dx, dy))
             q.put(_DONE)
         except BaseException as e:  # forward to the consumer, don't swallow

@@ -86,9 +86,12 @@ class Candidate:
 
 def candidate_attrs(cand: "Candidate") -> Dict[str, str]:
     """Strategy attrs a chosen candidate implies (consumed by the lowering
-    via LoweringCtx.op_attrs): inter:{axis} -> fork_join branch placement."""
+    via LoweringCtx.op_attrs): inter:{axis} -> fork_join branch placement;
+    sp_ring:{axis} -> ring-attention sequence parallelism."""
     if cand.name.startswith("inter:"):
         return {"placement": cand.name.split(":", 1)[1]}
+    if cand.name.startswith("sp_ring:"):
+        return {"seq_parallel": cand.name.split(":", 1)[1]}
     return {}
 
 
@@ -172,6 +175,47 @@ def layer_candidates(layer: "Layer", machine: MachineSpec, batch_sizes,
                 compute_degree=max(1, dp.compute_degree) * dm,
                 extra_comm=cm.all_reduce_time(out_bytes, (m,), machine),
                 eff=min(1.0, (embed // dm) / machine.mxu_min_dim)))
+        # sequence parallelism: ring attention over a mesh axis (SURVEY P10
+        # extension; kernels/ring_attention.py). q/k/v/out sharded on the
+        # seq dim; k/v shards rotate (P-1) hops around the ring. Scope:
+        # self-attention shapes (sq == sk; the ring's causal offsets assume
+        # one chunk length) and no forced impl="xla".
+        q, kspec = ispecs[0], ispecs[1]
+        seq, seq_k = q.shape[1], kspec.shape[1]
+        head_d = layer.params["embed_dim"] // max(1, heads)
+        if not layer.params.get("add_bias_kv") and \
+                not layer.params.get("add_zero_attn") and \
+                not layer.params.get("dropout") and \
+                layer.params.get("impl", "auto") != "xla" and \
+                seq == seq_k == ispecs[2].shape[1]:
+            for m in maxes:
+                dm = machine.mesh_axes[m]
+                if seq % dm:
+                    continue
+                sdims = [[dp_in[0][0], m, None]] * 3
+                sout = [[dp_out[0][0], m, None]]
+                kv_chunk = cm.shard_bytes(kspec, sdims[1], machine)
+                ring_comm = 2.0 * (dm - 1) * kv_chunk / machine.axis_bw(m)
+                cands.append(Candidate(
+                    f"sp_ring:{m}", sdims, sout, dict(repl_w),
+                    compute_degree=max(1, dp.compute_degree) * dm,
+                    extra_comm=ring_comm))
+        # where the flash kernel can't cover the shape (q OR k/v past the
+        # VMEM budget, or causal cross-shapes), non-ring candidates pay the
+        # full (sq, sk) logits materialization through HBM (3x for fwd+bwd)
+        from flexflow_tpu.kernels.flash_attention import flash_supported
+
+        isz = q.dtype.itemsize
+        flash_ok = (flash_supported(seq, head_d, isz)
+                    and flash_supported(seq_k, head_d, isz)
+                    and (not layer.params.get("causal") or seq == seq_k))
+        if not flash_ok:
+            logits_bytes = q.shape[0] * heads * seq * seq_k * max(4, isz)
+            for c in cands:
+                if not c.name.startswith("sp_ring:"):
+                    c.extra_comm += (3.0 * 2.0 * logits_bytes
+                                     / max(1, c.compute_degree)
+                                     / machine.hbm_bw)
 
     elif t is OperatorType.EMBEDDING:
         tbl = layer.weight_specs["kernel"]

@@ -1,0 +1,63 @@
+"""Worker for the 2-process multi-host test (mpi_wrapper analog) — run by
+tests/test_multihost.py, one subprocess per "host", each with 4 virtual CPU
+devices; jax.distributed stitches them into one 8-device world."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+port, nproc, pid = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=4")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from flexflow_tpu.runtime.distributed import init_distributed, is_multiprocess
+
+init_distributed(coordinator_address=f"127.0.0.1:{port}",
+                 num_processes=nproc, process_id=pid)
+
+assert jax.process_count() == nproc, jax.process_count()
+assert jax.device_count() == 4 * nproc, jax.device_count()
+assert len(jax.local_devices()) == 4
+assert is_multiprocess()
+
+import numpy as np
+
+from flexflow_tpu import FFConfig, FFModel, SGDOptimizer
+
+cfg = FFConfig(batch_size=32, epochs=2, mesh_shape={"data": 4 * nproc},
+               only_data_parallel=True, seed=7)
+m = FFModel(cfg)
+x = m.create_tensor([32, 16], name="x")
+h = m.dense(x, 64, activation="relu", name="fc1")
+m.dense(h, 4, name="head")
+cm = m.compile(SGDOptimizer(lr=0.05),
+               loss_type="sparse_categorical_crossentropy", metrics=[])
+cm.init(seed=0)
+
+rng = np.random.default_rng(0)  # identical dataset on every process
+xv = rng.normal(size=(128, 16)).astype(np.float32)
+w = rng.normal(size=(16, 4)).astype(np.float32)
+yv = np.argmax(xv @ w, axis=1).astype(np.int32)
+hist = cm.fit(xv, yv, verbose=False)
+losses = [h["loss"] for h in hist]
+assert all(np.isfinite(l) for l in losses), losses
+assert losses[-1] < losses[0], losses
+# every host->device data path must be multi-process-safe (round-4 review):
+ev = cm.evaluate(xv, yv)
+assert np.isfinite(ev["loss"]), ev
+out = cm.forward(xv[:32])
+assert out.shape == (32, 4)  # global shape; values span both processes
+local = np.concatenate([np.asarray(s.data) for s in out.addressable_shards])
+assert local.shape == (16, 4) and np.isfinite(local).all()
+cm.set_weight("head", "kernel", np.zeros((64, 4), np.float32))
+assert float(np.abs(cm.get_weight("head", "kernel")).sum()) == 0.0
+# the global weight state must be identical across processes: fetch a
+# replicated weight and print its hash for the parent to compare
+wk = np.asarray(jax.device_get(cm.params["fc1"]["kernel"]))
+print(f"RESULT pid={pid} loss={losses[-1]:.6f} wsum={float(np.abs(wk).sum()):.6f}",
+      flush=True)

@@ -1,0 +1,121 @@
+"""§5a profiling hooks + the last config flags (round-2 bar: zero
+accepted-and-ignored flags): profiling (jax.profiler trace + per-op timing
+table), enable_fusion (fused-kernel gate), include_costs_dot_graph,
+search_num_nodes/search_num_workers (search-for-a-bigger-machine)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from flexflow_tpu import FFConfig, FFModel, SGDOptimizer
+
+
+def _tiny_fit_model(cfg):
+    m = FFModel(cfg)
+    x = m.create_tensor([16, 8], name="x")
+    m.dense(m.dense(x, 16, activation="relu", name="fc1"), 4, name="fc2")
+    rng = np.random.default_rng(0)
+    xv = rng.normal(size=(16, 8)).astype(np.float32)
+    yv = rng.integers(0, 4, size=(16,)).astype(np.int32)
+    return m, xv, yv
+
+
+def test_profiling_writes_trace_and_report(devices, tmp_path, capsys):
+    pdir = str(tmp_path / "trace")
+    cfg = FFConfig(batch_size=16, epochs=1, only_data_parallel=True,
+                   profiling=True, profile_dir=pdir)
+    m, xv, yv = _tiny_fit_model(cfg)
+    m.compile(SGDOptimizer(lr=0.01),
+              loss_type="sparse_categorical_crossentropy", metrics=[])
+    m.fit(xv, yv, verbose=True)
+    # the xplane trace landed on disk (jax.profiler.trace analog of the
+    # reference's Legion trace, flexflow_c.cc:1747)
+    found = []
+    for root, _dirs, files in os.walk(pdir):
+        found += [f for f in files if f.endswith((".pb", ".xplane.pb", ".json.gz"))]
+    assert found, f"no trace artifacts under {pdir}"
+    out = capsys.readouterr().out
+    assert "[profiling] trace written" in out
+    # per-op table printed (linear_kernels.cu --profiling prints analog)
+    assert "fc1" in out and "measured" in out
+
+
+def test_profile_report_rows(devices):
+    cfg = FFConfig(batch_size=16, only_data_parallel=True)
+    m, xv, yv = _tiny_fit_model(cfg)
+    cm = m.compile(SGDOptimizer(lr=0.01),
+                   loss_type="sparse_categorical_crossentropy", metrics=[])
+    cm.init(seed=0)
+    rows = cm.profile_report(print_table=False)
+    names = {r["layer"] for r in rows}
+    assert {"fc1", "fc2"} <= names
+    assert all(np.isfinite(r["measured_us"]) and r["measured_us"] > 0
+               for r in rows)
+
+
+def test_enable_fusion_gates_flash_kernel(devices, monkeypatch):
+    """enable_fusion=False must route 'auto' attention away from the fused
+    pallas kernel (reference --fusion gates FusedOp)."""
+    import importlib
+
+    fa = importlib.import_module("flexflow_tpu.kernels.flash_attention")
+    calls = []
+    real = fa.flash_attention_qkv
+
+    def spy(*a, **k):
+        calls.append(1)
+        return real(*a, **k)
+
+    monkeypatch.setattr(fa, "flash_attention_qkv", spy)
+
+    def run(enable_fusion):
+        calls.clear()
+        cfg = FFConfig(batch_size=2, only_data_parallel=True,
+                       enable_fusion=enable_fusion)
+        m = FFModel(cfg)
+        x = m.create_tensor([2, 128, 32], name="x")
+        m.multihead_attention(x, x, x, 32, 2, dropout=0.0, name="attn")
+        cm = m.compile(SGDOptimizer(lr=0.01), loss_type="mean_squared_error",
+                       metrics=[])
+        cm.init(seed=0)
+        cm.forward(np.zeros((2, 128, 32), np.float32))
+        return len(calls)
+
+    assert run(True) > 0        # auto + fusion: fused kernel used
+    assert run(False) == 0      # fusion off: einsum path only
+
+
+def test_include_costs_dot_graph(devices):
+    cfg = FFConfig(batch_size=16, only_data_parallel=True,
+                   include_costs_dot_graph=True)
+    m, xv, yv = _tiny_fit_model(cfg)
+    m.compile(SGDOptimizer(lr=0.01),
+              loss_type="sparse_categorical_crossentropy", metrics=[])
+    dot_plain = m.dot(include_costs=False)
+    dot_costs = m.dot()  # cfg default: include_costs_dot_graph=True
+    assert "us" not in dot_plain.replace("aus", "")  # no cost annotations
+    assert "us" in dot_costs and dot_costs != dot_plain
+
+
+def test_search_num_nodes_workers_strategy_export(devices, tmp_path):
+    """Search strategies for a machine LARGER than the real one and export
+    them (reference --search-num-nodes/--search-num-workers + --export,
+    config.h:154-155, substitution.cc:1729-1731)."""
+    out = str(tmp_path / "strategy.json")
+    cfg = FFConfig(batch_size=64, search_budget=16,
+                   search_num_nodes=2, search_num_workers=4,
+                   export_strategy_file=out)
+    m = FFModel(cfg)
+    x = m.create_tensor([64, 2048], name="x")
+    h = m.dense(x, 8192, activation="gelu", name="up")
+    m.dense(h, 2048, name="down")
+    m.compile(SGDOptimizer(lr=0.01), loss_type="mean_squared_error", metrics=[])
+    from flexflow_tpu.parallel.sharding import Strategy
+
+    st = Strategy.load(out)
+    # the searched machine is 2 (DCN) x 4: the exported strategy shards the
+    # fat MLP weights over the 4-worker model axis
+    assert st.mesh_axes == {"data": 2, "model": 4}, st.mesh_axes
+    assert st.op_shardings["up"].weights.get("kernel") == [None, "model"], \
+        st.op_shardings["up"].weights

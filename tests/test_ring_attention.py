@@ -1,0 +1,109 @@
+"""Ring attention (sequence parallelism, SURVEY P10 extension): numerics vs
+the flash/einsum paths at overlapping shapes, the search rule that selects
+it past the flash kernel's VMEM budget, and long-context training with the
+sequence dim sharded over the mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from flexflow_tpu import FFConfig, FFModel, SGDOptimizer
+from flexflow_tpu.kernels.flash_attention import flash_supported
+from flexflow_tpu.kernels.ring_attention import ring_attention
+from flexflow_tpu.parallel.machine import MachineSpec, build_mesh
+from flexflow_tpu.search.dp import search_graph
+
+MACH = MachineSpec(mesh_axes={"data": 2, "model": 4}, chip="v5p")
+
+
+def _ref_attention(q, k, v, causal):
+    d = q.shape[-1]
+    s = q.shape[2]
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(d)
+    if causal:
+        logits = jnp.where(jnp.tril(jnp.ones((s, s), bool)), logits, -jnp.inf)
+    return jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(logits, -1), v)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_matches_reference(devices, causal):
+    mesh = build_mesh(MACH)
+    rng = np.random.default_rng(0)
+    b, h, s, d = 4, 2, 256, 32
+    q, k, v = (jnp.asarray(rng.normal(size=(b, h, s, d)), jnp.float32)
+               for _ in range(3))
+    out = ring_attention(q, k, v, mesh, "model", causal=causal)
+    want = _ref_attention(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ring_gradients_match(devices):
+    mesh = build_mesh(MACH)
+    rng = np.random.default_rng(1)
+    b, h, s, d = 2, 2, 128, 16
+    q, k, v = (jnp.asarray(rng.normal(size=(b, h, s, d)), jnp.float32)
+               for _ in range(3))
+
+    gr = jax.grad(lambda *a: jnp.sum(
+        ring_attention(*a, mesh, "model", causal=True) ** 2), (0, 1, 2))(q, k, v)
+    gf = jax.grad(lambda *a: jnp.sum(
+        _ref_attention(*a, True) ** 2), (0, 1, 2))(q, k, v)
+    for a, b_ in zip(gr, gf):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def _mha_model(batch, seq, embed, heads):
+    m = FFModel(FFConfig(batch_size=batch,
+                         mesh_shape={"data": 2, "model": 4}))
+    x = m.create_tensor([batch, seq, embed], name="x")
+    m.multihead_attention(x, x, x, embed, heads, dropout=0.0, causal=True,
+                          name="attn")
+    return m
+
+
+def test_search_selects_ring_past_vmem_budget():
+    """The nonnegotiable round-3 gap: beyond the flash kernel's VMEM budget
+    attention fell back to full (s, s) logits. The search must now route
+    such shapes to the ring path — and must NOT pick it where flash covers
+    the shape and the ring hops would be pure overhead."""
+    assert not flash_supported(16384, 64)
+    long = _mha_model(2, 16384, 128, 2)
+    r = search_graph(long, MACH)
+    assert r.choices["attn"].name == "sp_ring:model", r.choices["attn"].name
+
+    assert flash_supported(512, 64)
+    short = _mha_model(8, 512, 128, 2)
+    r2 = search_graph(short, MACH)
+    assert not r2.choices["attn"].name.startswith("sp_ring"), \
+        r2.choices["attn"].name
+
+
+def test_long_context_trains_seq_sharded(devices):
+    """End-to-end long-context training: a sequence past the VMEM budget
+    compiles and trains with the attention sequence-sharded over the mesh
+    (round 3 materialized full logits here)."""
+    batch, seq, embed, heads = 2, 8192, 256, 2
+    assert not flash_supported(seq, embed // heads)
+    cfg = FFConfig(batch_size=batch, mesh_shape={"data": 2, "model": 4},
+                   search_budget=8)
+    m = FFModel(cfg)
+    x = m.create_tensor([batch, seq, embed], name="x")
+    m.multihead_attention(x, x, x, embed, heads, dropout=0.0, causal=True,
+                          name="attn")
+    cm = m.compile(SGDOptimizer(lr=0.001), loss_type="mean_squared_error",
+                   metrics=[])
+    sh = cm.strategy.op_shardings["attn"]
+    assert sh.attrs.get("seq_parallel") == "model", (sh.attrs, cm.strategy.name)
+    # output is genuinely sequence-sharded on the mesh
+    pv = cm.parallel_view("attn")
+    assert pv.dims[1].axes == ("model",) and pv.dims[1].shard_size == seq // 4
+
+    cm.init(seed=0)
+    rng = np.random.default_rng(0)
+    xv = rng.normal(size=(batch, seq, embed), scale=0.1).astype(np.float32)
+    yv = rng.normal(size=(batch, seq, embed), scale=0.1).astype(np.float32)
+    h = cm.fit(xv, yv, epochs=1, verbose=False)
+    assert np.isfinite(h[0]["loss"])

@@ -1,0 +1,168 @@
+"""Cost-model calibration harness: analytic vs measured vs whole-step time.
+
+Reference analog: the simulator's fidelity contract — per-op costs come from
+real on-device microbenchmarks (Op::inner_measure_operator_cost,
+/root/reference/src/runtime/model.cu:38-74) and are trusted to predict the
+iteration time. SURVEY §7 hard part #1 is the TPU version of that trap: XLA
+fuses across ops, so isolated per-op measurements over-predict the fused
+whole step. This harness quantifies that error per workload:
+
+  analytic  = Σ per-layer analytic roofline op_time under the DP strategy
+  measured  = Σ per-layer MeasuredCost op_time (isolated jit per op)
+  step      = real wall-clock train_step time (fit-path, fwd+bwd+update)
+
+and writes the table to CALIBRATION.md. Run on the CPU mesh (cpu-sim
+coefficients) or a real chip:
+
+    python tools/calibrate.py [--out CALIBRATION.md]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+
+def _workloads():
+    import numpy as np
+
+    from flexflow_tpu import FFConfig, FFModel
+
+    def mlp():
+        m = FFModel(FFConfig(batch_size=64, only_data_parallel=True))
+        x = m.create_tensor([64, 512], name="x")
+        h = m.dense(x, 1024, activation="relu", name="fc1")
+        h = m.dense(h, 1024, activation="relu", name="fc2")
+        m.dense(h, 10, name="head")
+        y = np.random.default_rng(0).integers(0, 10, size=(64,)).astype(np.int32)
+        return m, np.random.default_rng(1).normal(size=(64, 512)).astype(np.float32), y
+
+    def cnn():
+        m = FFModel(FFConfig(batch_size=32, only_data_parallel=True))
+        x = m.create_tensor([32, 3, 32, 32], name="x")
+        h = m.conv2d(x, 32, 3, 3, padding_h=1, padding_w=1, activation="relu", name="c1")
+        h = m.pool2d(h, 2, 2, 2, 2, name="p1")
+        h = m.conv2d(h, 64, 3, 3, padding_h=1, padding_w=1, activation="relu", name="c2")
+        h = m.pool2d(h, 2, 2, 2, 2, name="p2")
+        h = m.flat(h, name="flat")
+        m.dense(h, 10, name="head")
+        y = np.random.default_rng(0).integers(0, 10, size=(32,)).astype(np.int32)
+        return m, np.random.default_rng(1).normal(size=(32, 3, 32, 32)).astype(np.float32), y
+
+    def gpt2_block():
+        from flexflow_tpu.models import GPT2Config, build_gpt2
+
+        cfg = GPT2Config(vocab=2048, seq=64, d_model=256, heads=4, layers=1,
+                         dropout=0.0)
+        m = FFModel(FFConfig(batch_size=4, only_data_parallel=True))
+        build_gpt2(m, cfg, batch=4)
+        rng = np.random.default_rng(0)
+        ids = rng.integers(0, cfg.vocab, size=(4, 64)).astype(np.int32)
+        pos = np.tile(np.arange(64, dtype=np.int32), (4, 1))
+        lab = rng.integers(0, cfg.vocab, size=(4, 64)).astype(np.int32)
+        return m, [ids, pos], lab
+
+    return [("mlp", mlp), ("cnn", cnn), ("gpt2_block", gpt2_block)]
+
+
+def calibrate(names=None):
+    import jax
+    import numpy as np
+
+    from flexflow_tpu import SGDOptimizer
+    from flexflow_tpu.parallel.machine import MachineSpec
+    from flexflow_tpu.search.dp import search_graph
+    from flexflow_tpu.search.measure import MeasuredCost
+
+    machine = MachineSpec.detect()
+    rows = []
+    for name, builder in _workloads():
+        if names and name not in names:
+            continue
+        model, x, y = builder()
+        r = search_graph(model, machine, enable_parameter=False,
+                         enable_attribute=False)
+        analytic = sum(r.choices[l.name].op_time(l, machine)
+                       for l in model.layers)
+        mc = MeasuredCost(machine, repeats=5, warmup=2)
+        measured = sum(mc.op_time(l, r.choices[l.name]) for l in model.layers)
+
+        loss_t = ("sparse_categorical_crossentropy"
+                  if np.asarray(y).dtype == np.int32 else "mean_squared_error")
+        cm = model.compile(SGDOptimizer(lr=0.01), loss_type=loss_t, metrics=[])
+        cm.init(seed=0)
+        xs = x if isinstance(x, list) else [x]
+        dx = [jax.device_put(a) for a in xs]
+        dy = jax.device_put(y)
+        key = jax.random.PRNGKey(0)
+        # warmup/compile, then best-of-3 timed runs of 5 chained steps
+        p, o, s, loss, _ = cm.train_step(cm.params, cm.opt_state, cm.state,
+                                         dx, dy, key)
+        jax.block_until_ready((loss, p, o))
+        best = float("inf")
+        for rep in range(3):
+            t0 = time.perf_counter()
+            for i in range(5):
+                p, o, s, loss, _ = cm.train_step(p, o, s, dx, dy,
+                                                 jax.random.fold_in(key, i))
+            jax.block_until_ready((loss, p, o))
+            best = min(best, (time.perf_counter() - t0) / 5)
+        rows.append({
+            "workload": name,
+            "analytic_ms": analytic * 1e3,
+            "measured_ms": measured * 1e3,
+            "step_ms": best * 1e3,
+            "analytic_over_step": analytic / best,
+            "measured_over_step": measured / best,
+        })
+    return rows, machine
+
+
+def write_report(rows, machine, path="CALIBRATION.md"):
+    import jax
+
+    lines = [
+        "# Cost-model calibration",
+        "",
+        f"Backend: `{jax.default_backend()}` ({len(jax.devices())} device(s)); "
+        f"machine model chip: `{machine.chip}`. Produced by "
+        "`python tools/calibrate.py`.",
+        "",
+        "Columns: per-layer **analytic** roofline sum and per-layer isolated "
+        "**measured** sum vs the real fused whole **step** (fwd+bwd+update), "
+        "all under the data-parallel strategy. Ratios are predicted/actual — "
+        "1.0 is perfect; the known bias (SURVEY §7 hard part #1) is that "
+        "isolated measurement over-predicts what XLA fuses, while the "
+        "analytic model targets the chip's steady-state rates and "
+        "under-predicts small-shape dispatch overheads on CPU.",
+        "",
+        "| workload | analytic (ms) | measured-sum (ms) | whole step (ms) | "
+        "analytic/step | measured/step |",
+        "|---|---|---|---|---|---|",
+    ]
+    for r in rows:
+        lines.append(
+            f"| {r['workload']} | {r['analytic_ms']:.3f} | "
+            f"{r['measured_ms']:.3f} | {r['step_ms']:.3f} | "
+            f"{r['analytic_over_step']:.3f} | {r['measured_over_step']:.3f} |")
+    lines.append("")
+    with open(path, "w") as f:
+        f.write("\n".join(lines))
+    return path
+
+
+if __name__ == "__main__":
+    import os
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="CALIBRATION.md")
+    ap.add_argument("--workloads", default="", help="comma-separated subset")
+    args = ap.parse_args()
+    names = [w for w in args.workloads.split(",") if w] or None
+    rows, machine = calibrate(names)
+    path = write_report(rows, machine, args.out)
+    for r in rows:
+        print(r)
+    print(f"wrote {path}", file=sys.stderr)
