@@ -76,6 +76,119 @@ def _bench_model(cfg, batch, searched: bool, on_cpu: bool):
     return iters * batch / best_dt, best_dt / iters
 
 
+def _bench_workload(build_fn, inputs_fn, loss_type, batch, iters, warmup=2):
+    """Generic train-throughput bench: build, compile (DP), chained timed
+    steps with full (loss, params, opt_state) sync; returns samples/sec."""
+    import jax
+
+    from flexflow_tpu import AdamOptimizer, FFConfig, FFModel
+
+    ff_cfg = FFConfig(batch_size=batch, compute_dtype="bfloat16",
+                      only_data_parallel=True)
+    model = FFModel(ff_cfg)
+    out = build_fn(model)
+    cm = model.compile(AdamOptimizer(alpha=1e-4), loss_type=loss_type,
+                       metrics=[], outputs=[out] if out is not None else None)
+    cm.init(seed=0)
+    xs, labels = inputs_fn()
+    dx = [jax.device_put(a) for a in xs]
+    dy = jax.device_put(labels)
+    key = jax.random.PRNGKey(0)
+    for i in range(warmup):
+        cm.params, cm.opt_state, cm.state, loss, _ = cm.train_step(
+            cm.params, cm.opt_state, cm.state, dx, dy, jax.random.fold_in(key, i))
+    jax.block_until_ready((loss, cm.params, cm.opt_state))
+    best = float("inf")
+    for rep in range(3):
+        t0 = time.perf_counter()
+        for i in range(iters):
+            cm.params, cm.opt_state, cm.state, loss, _ = cm.train_step(
+                cm.params, cm.opt_state, cm.state, dx, dy,
+                jax.random.fold_in(key, 100 + rep * iters + i))
+        jax.block_until_ready((loss, cm.params, cm.opt_state))
+        best = min(best, time.perf_counter() - t0)
+    assert np.isfinite(float(loss)), loss
+    return iters * batch / best
+
+
+def _bench_bert(on_cpu: bool) -> float:
+    """BASELINE config #3: BERT-base pretraining proxy throughput."""
+    from flexflow_tpu.models import build_bert
+
+    if on_cpu:
+        batch, seq, kw = 2, 64, dict(vocab=2048, d_model=128, heads=2,
+                                     layers=2, d_ff=256)
+    else:
+        batch, seq, kw = 8, 512, {}
+
+    holder = {}
+
+    def build(m):
+        ins, logits = build_bert(m, batch=batch, seq=seq, **kw)
+        holder["vocab"] = kw.get("vocab", 30522)
+        return logits
+
+    def inputs():
+        rng = np.random.default_rng(0)
+        ids = rng.integers(0, holder["vocab"], size=(batch, seq)).astype(np.int32)
+        pos = np.tile(np.arange(seq, dtype=np.int32), (batch, 1))
+        lab = rng.integers(0, holder["vocab"], size=(batch, seq)).astype(np.int32)
+        return [ids, pos], lab
+
+    return _bench_workload(build, inputs, "sparse_categorical_crossentropy",
+                           batch, iters=2 if on_cpu else 10)
+
+
+def _bench_dlrm(on_cpu: bool) -> float:
+    """BASELINE config #4: DLRM click-through throughput."""
+    from flexflow_tpu.models import build_dlrm
+
+    batch = 256 if on_cpu else 4096
+    tables = (10_000,) * 4 if on_cpu else (100_000,) * 8
+
+    def build(m):
+        ins, out = build_dlrm(m, batch=batch, embedding_tables=tables,
+                              embedding_dim=64)
+        return out
+
+    def inputs():
+        rng = np.random.default_rng(0)
+        dense = rng.normal(size=(batch, 13)).astype(np.float32)
+        sparse = [rng.integers(0, t, size=(batch, 1)).astype(np.int32)
+                  for t in tables]
+        lab = rng.uniform(size=(batch, 1)).astype(np.float32)
+        return [dense] + sparse, lab
+
+    return _bench_workload(build, inputs, "mean_squared_error", batch,
+                           iters=3 if on_cpu else 20)
+
+
+def _predicted_multichip_ratio():
+    """Cost-model-predicted searched-vs-expert ratio for the v5p TARGET mesh
+    (8 data x 4 model): both strategies costed by the same frontier DP,
+    entirely analytic (no devices needed). This — not the 1-chip wall-clock
+    number — is the meaningful multi-chip anchor the single-chip bench can
+    produce; MULTICHIP_r04's dryrun measures the executable CPU-mesh twin."""
+    from flexflow_tpu import FFConfig, FFModel
+    from flexflow_tpu.models import GPT2Config, build_gpt2
+    from flexflow_tpu.parallel.machine import MachineSpec
+    from flexflow_tpu.search.dp import search_graph
+
+    cfg = GPT2Config.medium()
+    cfg.dropout = 0.0
+    model = FFModel(FFConfig(batch_size=32))
+    build_gpt2(model, cfg, batch=32)
+    mach = MachineSpec(mesh_axes={"data": 8, "model": 4}, chip="v5p")
+    searched = search_graph(model, mach).cost
+    pins = {}
+    for i in range(cfg.layers):
+        pins[f"h{i}_attn"] = "tp_heads:model"
+        pins[f"h{i}_mlp_up"] = "tp_col:model"
+        pins[f"h{i}_mlp_down"] = "tp_row:model"
+    expert = search_graph(model, mach, pins=pins).cost
+    return expert / searched
+
+
 def main():
     import jax
 
@@ -99,6 +212,9 @@ def main():
     # north-star: searched_vs_expert (target >= 0.90)
     sps, step_dt = _bench_model(cfg, batch, searched=False, on_cpu=on_cpu)
     searched_sps, _ = _bench_model(cfg, batch, searched=True, on_cpu=on_cpu)
+    bert_sps = _bench_bert(on_cpu)
+    dlrm_sps = _bench_dlrm(on_cpu)
+    predicted_ratio = _predicted_multichip_ratio()
 
     n_chips = max(1, len(jax.devices()))
     sps_chip = sps / n_chips
@@ -124,7 +240,15 @@ def main():
         "vs_baseline": round(sps_chip / ref_sps, 4),
         "mfu": round(mfu, 4),
         "step_ms": round(step_dt * 1e3, 2),
+        # 1-chip searched-vs-expert: the mesh has ONE device, so the search
+        # has nothing to shard — this checks search/jit overhead only. The
+        # multi-chip anchor is the PREDICTED ratio below (cost model on the
+        # v5p 8x4 target mesh) + the dryrun's executable CPU-mesh ratio.
         "searched_vs_expert": round(searched_sps / sps, 4),
+        "searched_vs_expert_note": "1-chip overhead check, not a sharding anchor",
+        "predicted_multichip_searched_vs_expert": round(predicted_ratio, 4),
+        "bert_samples_per_sec_per_chip": round(bert_sps / n_chips, 3),
+        "dlrm_samples_per_sec_per_chip": round(dlrm_sps / n_chips, 3),
         "batch": batch,
         "seq": cfg.seq,
         "chip_peak_tflops": round(machine.flops / 1e12, 1),

@@ -58,6 +58,15 @@ def _pick_strategy(model, machine: MachineSpec) -> Strategy:
     if cfg.import_strategy_file:
         return Strategy.load(cfg.import_strategy_file)
     sm = _search_machine(cfg, machine)
+    if sm is not machine and sm.mesh_axes != machine.mesh_axes \
+            and not cfg.export_strategy_file:
+        import warnings
+
+        warnings.warn(
+            f"searching for machine {sm.mesh_axes} but executing on "
+            f"{machine.mesh_axes}: shardings that don't fit the real mesh "
+            "degrade to replicated — --search-num-nodes/--search-num-workers "
+            "are meant to be paired with --export")
     if cfg.search_budget > 0 and not cfg.only_data_parallel and sm.num_devices > 1:
         try:
             from flexflow_tpu.search.optimize import graph_optimize
@@ -288,7 +297,10 @@ class CompiledModel:
                 if verbose:
                     print(f"[profiling] trace written to "
                           f"{self.cfg.profile_dir or './ff_profile'}")
-                    self.profile_report()
+        # per-op table only on the success path (it launches measurement
+        # jits; on an error path it would mask the real exception)
+        if prof_ctx is not None and verbose:
+            self.profile_report()
         return history
 
     def _fit_epochs(self, epochs, loader, in_sh, lab_sh, base_rng,
@@ -352,23 +364,44 @@ class CompiledModel:
         return outs[0] if len(outs) == 1 else outs
 
     # ------------------------------------------------------------ profiling
+    def _candidate_for(self, layer):
+        """The sharding candidate matching the COMPILED strategy's weight
+        layout for this layer (falls back to dp when nothing matches)."""
+        from flexflow_tpu.search.candidates import layer_candidates
+
+        batch_sizes = {t.shape[0] for t in self.model.input_tensors if t.ndim > 0}
+        cands = layer_candidates(layer, self.machine, batch_sizes)
+        sh = self.strategy.op_shardings.get(layer.name)
+
+        def norm(dims):
+            return [None if d in (None, []) else (d if isinstance(d, str) else tuple(d))
+                    for d in (dims or [])]
+
+        if sh is not None:
+            want_w = {w: norm(d) for w, d in sh.weights.items()}
+            for c in cands:
+                if {w: norm(d) for w, d in c.weight_dims.items()} == want_w \
+                        and not c.passthrough:
+                    return c
+        return cands[0]
+
     def profile_report(self, top: int = 0, print_table: bool = True):
         """Per-op timing table (reference: per-kernel ms prints behind
         --profiling, src/ops/kernels/linear_kernels.cu:98-117): each layer's
-        analytic roofline prediction and isolated measured time under its
-        compiled sharding's nearest candidate. Returns the rows."""
-        from flexflow_tpu.search.dp import search_graph
+        analytic roofline prediction and isolated measured time under the
+        candidate matching its COMPILED sharding. Returns the rows."""
         from flexflow_tpu.search.measure import MeasuredCost
 
-        r = search_graph(self.model, self.machine, enable_parameter=False,
-                         enable_attribute=False)
         mc = MeasuredCost(self.machine, repeats=3, warmup=1)
         rows = []
         for layer in self.model.layers:
-            cand = r.choices[layer.name]
+            cand = self._candidate_for(layer)
+            if cand.passthrough:
+                continue
             rows.append({
                 "layer": layer.name,
                 "op": layer.op_type.value,
+                "candidate": cand.name,
                 "analytic_us": cand.op_time(layer, self.machine) * 1e6,
                 "measured_us": mc.op_time(layer, cand) * 1e6,
             })

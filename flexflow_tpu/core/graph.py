@@ -16,8 +16,15 @@ from flexflow_tpu.core.layer import Layer
 
 
 def topo_order(layers: Sequence[Layer]) -> List[Layer]:
-    """Kahn topological order over layer dependencies (input-tensor owners)."""
+    """Kahn topological order over layer dependencies (input-tensor owners).
+    Large graphs take the native C++ path (flexflow_tpu/native, same stable
+    traversal); this Python body is the reference implementation and the
+    fallback."""
     layers = list(layers)
+    if len(layers) >= 32:
+        native_order = _native_topo(layers)
+        if native_order is not None:
+            return native_order
     index = {l: i for i, l in enumerate(layers)}
     indeg = {l: 0 for l in layers}
     succs: Dict[Layer, List[Layer]] = defaultdict(list)
@@ -39,6 +46,23 @@ def topo_order(layers: Sequence[Layer]) -> List[Layer]:
     if len(out) != len(layers):
         raise ValueError("cycle detected in layer graph")
     return out
+
+
+def _native_topo(layers: List[Layer]):
+    try:
+        from flexflow_tpu import native
+    except Exception:  # pragma: no cover
+        return None
+    if not native.available():
+        return None
+    index = {l: i for i, l in enumerate(layers)}
+    edges = [(index[t.owner], li)
+             for li, l in enumerate(layers) for t in l.inputs
+             if t.owner is not None and t.owner in index]
+    order = native.topo_order_indices(len(layers), edges)  # raises on cycle
+    if order is None:
+        return None
+    return [layers[i] for i in order]
 
 
 def predecessors(layer: Layer, universe: Set[Layer]) -> List[Layer]:

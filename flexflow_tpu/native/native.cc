@@ -1,0 +1,70 @@
+// Native runtime core — C++ hot paths behind the Python framework.
+//
+// Reference analog: the reference's runtime is C++ end to end (Legion glue,
+// src/runtime/*.cc); on TPU the compute path is XLA, but the HOST-side
+// runtime work — dataloader batch assembly (src/dataloader/dataloader.cc
+// shard scatter) and the search's graph algorithms
+// (include/flexflow/basic_graph.h, dominators.h) — stays native here too.
+//
+// Exposed as plain C symbols loaded via ctypes (no pybind11 in this image);
+// ctypes drops the GIL during calls, so batch_gather runs concurrently with
+// the training step inside the prefetch thread (the Legion-async analog).
+//
+// Build (done automatically on first import by flexflow_tpu/native):
+//   c++ -O3 -march=native -shared -fPIC -o _native.so native.cc
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+extern "C" {
+
+// Gather rows: dst[i] = src[idx[i]] for row_bytes-sized rows.
+// Returns 0 on success, -1 on an out-of-range index.
+int ff_batch_gather(const char* src, int64_t n_src_rows, char* dst,
+                    const int64_t* idx, int64_t n_idx, int64_t row_bytes) {
+  for (int64_t i = 0; i < n_idx; ++i) {
+    const int64_t j = idx[i];
+    if (j < 0 || j >= n_src_rows) return -1;
+    std::memcpy(dst + i * row_bytes, src + j * row_bytes,
+                static_cast<size_t>(row_bytes));
+  }
+  return 0;
+}
+
+// Kahn topological order with stable (original-index) tie-breaking — the
+// same traversal core/graph.py::topo_order implements in Python.
+// edges: n_edges pairs (src, dst). out receives the node order.
+// Returns 0 on success, -1 on a cycle.
+int ff_topo_order(int64_t n_nodes, int64_t n_edges, const int64_t* e_src,
+                  const int64_t* e_dst, int64_t* out) {
+  std::vector<int64_t> indeg(n_nodes, 0);
+  std::vector<int64_t> head(n_nodes, -1);   // adjacency: per-node edge list
+  std::vector<int64_t> next(n_edges, -1);
+  std::vector<int64_t> to(n_edges, -1);
+  // build adjacency in REVERSE so iteration yields original edge order
+  for (int64_t e = n_edges - 1; e >= 0; --e) {
+    const int64_t s = e_src[e];
+    to[e] = e_dst[e];
+    next[e] = head[s];
+    head[s] = e;
+    indeg[e_dst[e]] += 1;
+  }
+  // stable seed: min-heap on node index (graphs are small; O(n log n))
+  std::vector<int64_t> ready;
+  for (int64_t n = 0; n < n_nodes; ++n)
+    if (indeg[n] == 0) ready.push_back(n);
+  // core/graph.py uses FIFO over original order; replicate exactly
+  size_t qhead = 0;
+  int64_t count = 0;
+  while (qhead < ready.size()) {
+    const int64_t n = ready[qhead++];
+    out[count++] = n;
+    for (int64_t e = head[n]; e != -1; e = next[e]) {
+      if (--indeg[to[e]] == 0) ready.push_back(to[e]);
+    }
+  }
+  return count == n_nodes ? 0 : -1;
+}
+
+}  // extern "C"

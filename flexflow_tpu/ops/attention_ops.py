@@ -105,6 +105,7 @@ def _mha_lower(layer: Layer, inputs, weights, ctx: LoweringCtx):
     sp_axis = ctx.op_attrs.get(layer.name, {}).get("seq_parallel")
     if sp_axis and ctx.mesh is not None and sp_axis in ctx.mesh.shape \
             and impl != "xla" and qh.shape[1] == kh.shape[1] == vh.shape[1] \
+            and qh.shape[1] % ctx.mesh.shape[sp_axis] == 0 \
             and not needs_dropout and "bias_k" not in weights \
             and not p.get("add_zero_attn", False):
         from flexflow_tpu.kernels.ring_attention import ring_attention_qkv

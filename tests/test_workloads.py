@@ -1,0 +1,150 @@
+"""Round-4 workload evidence: attribute-parallel conv EXECUTES on the mesh
+(P3, halo validation), DLRM's searched strategy shards the embedding tables
+(the reference ships hand-tuned strategies for exactly this,
+examples/cpp/DLRM/strategies/), and recompile-on-condition drives the MoE
+cache-trigger use case (reference examples/cpp/mixture_of_experts/
+moe.cc:64-97)."""
+
+import numpy as np
+import pytest
+
+from flexflow_tpu import FFConfig, FFModel, SGDOptimizer
+from flexflow_tpu.compiler.lowering import build_forward
+from flexflow_tpu.models import build_dlrm
+from flexflow_tpu.parallel.machine import MachineSpec
+from flexflow_tpu.search.candidates import layer_candidates
+from flexflow_tpu.search.dp import search_graph
+
+MACH = MachineSpec(mesh_axes={"data": 2, "model": 4}, chip="v5p")
+
+
+# ------------------------------------------------------- attribute parallel
+def _conv_model(batch=8):
+    cfg = FFConfig(batch_size=batch, mesh_shape={"data": 2, "model": 4},
+                   only_data_parallel=True)
+    m = FFModel(cfg)
+    x = m.create_tensor([batch, 3, 16, 16], name="x")
+    h = m.conv2d(x, 8, 3, 3, padding_h=1, padding_w=1, activation="relu",
+                 name="c1")
+    h = m.pool2d(h, 2, 2, 2, 2, name="p1")
+    h = m.flat(h, name="flat")
+    m.dense(h, 4, name="head")
+    return m
+
+
+def test_attr_conv_candidate_carries_halo_cost():
+    m = _conv_model()
+    c1 = m.get_layer_by_name("c1")
+    cands = {c.name: c for c in layer_candidates(c1, MACH, {8})}
+    attr = cands.get("attr_h:model")
+    assert attr is not None, list(cands)
+    # halo = (kernel_h - 1) rows exchanged over the spatial axis: priced > 0
+    assert attr.extra_comm > 0.0
+    # spatially sharded in/out on H
+    assert attr.out_dims[0][2] == "model", attr.out_dims
+
+
+def test_attr_sharded_conv_executes_and_matches(devices):
+    """P3 'done' bar (open since round 1): a conv ATTRIBUTE-sharded on its
+    spatial dim actually runs on the mesh — GSPMD materializes the halo
+    exchange the candidate's cost term models — and matches the replicated
+    numerics."""
+    m = _conv_model()
+    cm = m.compile(SGDOptimizer(lr=0.01),
+                   loss_type="sparse_categorical_crossentropy", metrics=[])
+    cm.init(seed=0)
+    rng = np.random.default_rng(0)
+    xv = rng.normal(size=(8, 3, 16, 16)).astype(np.float32)
+    yv = rng.integers(0, 4, size=(8,)).astype(np.int32)
+    base = np.asarray(cm.forward(xv))
+
+    # re-lower the same weights with conv output sharded over H (attr_h)
+    sh = cm.strategy.op_shardings["c1"]
+    sh.outputs[0] = ["data", None, "model", None]
+    cm.forward_fn = build_forward(m.layers, m.input_tensors, cm.outputs,
+                                  cm.mesh, cm.strategy)
+    cm._build_steps()
+    attr_out = np.asarray(cm.forward(xv))
+    np.testing.assert_allclose(attr_out, base, rtol=2e-5, atol=2e-5)
+    # the sharding is real: H dim carries the model axis
+    pv = cm.parallel_view("c1")
+    assert pv.dims[2].axes == ("model",) and pv.dims[2].shard_size == 4
+
+    hist = cm.fit(xv, yv, epochs=2, verbose=False)
+    assert np.isfinite(hist[-1]["loss"])
+
+
+# ------------------------------------------------------------- DLRM search
+def test_dlrm_search_shards_embedding_tables():
+    """Not just 'cost is finite' (the round-3 smoke): the searched strategy
+    must shard the big embedding tables over the model axis — the known-good
+    structure of the reference's shipped DLRM strategies."""
+    m = FFModel(FFConfig(batch_size=64))
+    build_dlrm(m, batch=64, embedding_tables=(1_000_000,) * 4,
+               embedding_dim=64)
+    r = search_graph(m, MACH)
+    sharded = 0
+    for ti in range(4):
+        cand = r.choices[f"emb_{ti}"]
+        w = cand.weight_dims.get("kernel", [])
+        if any(a == "model" or (isinstance(a, tuple) and "model" in a)
+               for a in w if a):
+            sharded += 1
+    assert sharded == 4, {f"emb_{t}": r.choices[f"emb_{t}"].name
+                          for t in range(4)}
+    # and the bottom MLP stays unsharded-on-model at these small dims
+    assert r.choices["bot0"].name == "dp"
+
+
+def test_dlrm_unity_strategy_trains(devices):
+    m = FFModel(FFConfig(batch_size=16, mesh_shape={"data": 2, "model": 4},
+                         search_budget=8))
+    ins, out = build_dlrm(m, batch=16, embedding_tables=(8192,) * 4,
+                          embedding_dim=64)
+    cm = m.compile(SGDOptimizer(lr=0.01), loss_type="mean_squared_error",
+                   metrics=[], outputs=[out])
+    cm.init(seed=0)
+    rng = np.random.default_rng(0)
+    dense = rng.normal(size=(16, 13)).astype(np.float32)
+    sparse = [rng.integers(0, 8192, size=(16, 1)).astype(np.int32)
+              for _ in range(4)]
+    labels = rng.uniform(size=(16, 1)).astype(np.float32)
+    h = cm.fit([dense] + sparse, labels, epochs=1, verbose=False)
+    assert np.isfinite(h[0]["loss"])
+
+
+# ------------------------------------------------- recompile-on-condition
+def test_recompile_on_condition_moe_cache_trigger(devices):
+    """The MoE cache-trigger flow (reference moe.cc:64-97 + RecompileState,
+    include/flexflow/recompile.h:26-43): a predicate watched during fit
+    fires once, the alter function changes the execution config, and the
+    model is re-lowered mid-training without losing weights."""
+    cfg = FFConfig(batch_size=16, only_data_parallel=True, epochs=1)
+    m = FFModel(cfg)
+    x = m.create_tensor([16, 32], name="x")
+    h = m.moe(x, num_exp=4, num_select=2, expert_hidden_size=32, name="moe")
+    m.dense(h, 4, name="head")
+    cm = m.compile(SGDOptimizer(lr=0.01),
+                   loss_type="sparse_categorical_crossentropy", metrics=[])
+    cm.init(seed=0)
+
+    events = []
+    fwd_before = cm.forward_fn
+
+    def trigger(c):
+        # the cache-score analog: fire once after 3 optimizer steps
+        return c._iteration == 3 and not events
+
+    def alter(c):
+        events.append(c._iteration)
+        c.cfg.enable_fusion = False  # re-lower with a different exec config
+
+    cm.recompile_on_condition(trigger, alter)
+    rng = np.random.default_rng(0)
+    xv = rng.normal(size=(96, 32)).astype(np.float32)
+    yv = rng.integers(0, 4, size=(96,)).astype(np.int32)
+    hist = cm.fit(xv, yv, verbose=False)  # 6 steps of batch 16
+    assert events == [3], events
+    assert cm.forward_fn is not fwd_before  # genuinely re-lowered
+    assert np.isfinite(hist[0]["loss"])
+    assert cm._iteration == 6  # training continued after the recompile
