@@ -24,6 +24,16 @@ from flexflow_tpu.ops.op_type import OperatorType
 
 __version__ = "0.1.0"
 
+# set by the launcher (python -m flexflow_tpu script.py [flags]; see
+# flexflow_tpu/__main__.py — the flexflow_python/flexflow_top analog)
+_launch_config = None
+
+
+def get_launch_config() -> "FFConfig":
+    """The FFConfig the launcher parsed from the command line, or a default
+    config when the script runs standalone."""
+    return _launch_config if _launch_config is not None else FFConfig()
+
 __all__ = [
     "DataType",
     "FFConfig",

@@ -1,0 +1,48 @@
+"""Launcher: `python -m flexflow_tpu user_script.py [flags]`.
+
+Reference analog: the `flexflow_python` binary + flexflow_top.py top-level
+task (F5; python/flexflow/flexflow_python, python/flexflow/core/
+flexflow_top.py:164): the launcher owns runtime bring-up (flag parsing,
+platform/mesh selection, optional multi-process init) and then runs the user
+script, which reads its FFConfig from `flexflow_tpu.get_launch_config()`.
+
+Flags before the script path belong to the launcher/FFConfig; everything
+after the script path goes to the script's own argv.
+"""
+
+from __future__ import annotations
+
+import os
+import runpy
+import sys
+
+from flexflow_tpu.config import FFConfig
+
+def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    script = None
+    for i, a in enumerate(argv):
+        if a.endswith(".py"):
+            script = a
+            launcher_args, script_args = argv[:i], argv[i + 1:]
+            break
+    if script is None:
+        print("usage: python -m flexflow_tpu [flags] script.py [script args]\n"
+              "flags: the FFConfig CLI (-b, --budget, --mesh data=4,model=2, ...)",
+              file=sys.stderr)
+        return 2
+    # expose to the script via flexflow_tpu.get_launch_config()
+    import flexflow_tpu
+
+    flexflow_tpu._launch_config = FFConfig.parse_args(launcher_args)
+    if os.environ.get("FLEXFLOW_PLATFORM"):
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["FLEXFLOW_PLATFORM"])
+    sys.argv = [script] + script_args
+    runpy.run_path(script, run_name="__main__")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

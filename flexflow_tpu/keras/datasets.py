@@ -15,10 +15,19 @@ import numpy as np
 
 
 def _synthetic(shape_x, n_classes, n, seed):
+    """Deterministic synthetic images with LEARNABLE labels: each class has
+    a fixed random prototype pattern mixed into its images, so models
+    genuinely learn (train AND test accuracy rise above chance) and
+    accuracy-asserting tests work against synthetic data too (the
+    reference's examples/python/keras/accuracy.py pattern needs real
+    learnability, not random labels)."""
     rng = np.random.default_rng(seed)
-    x = rng.integers(0, 256, size=(n,) + shape_x).astype(np.uint8)
     y = rng.integers(0, n_classes, size=(n, 1)).astype(np.int64)
-    return x, y
+    noise = rng.integers(0, 256, size=(n,) + shape_x).astype(np.float32)
+    protos = np.random.default_rng(1234).normal(
+        size=(n_classes,) + shape_x).astype(np.float32)
+    x = noise + 45.0 * protos[y.reshape(-1)]
+    return np.clip(x, 0, 255).astype(np.uint8), y
 
 
 class _ImageDataset:

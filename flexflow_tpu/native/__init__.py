@@ -32,14 +32,21 @@ def _build() -> Optional[ctypes.CDLL]:
             return None
         if not os.path.exists(_SO) or \
                 os.path.getmtime(_SO) < os.path.getmtime(_SRC):
+            # pid-unique temp so concurrent processes can't corrupt the .so
+            # mid-write; os.replace is atomic
+            tmp = f"{_SO}.{os.getpid()}.tmp"
             cmd = ["c++", "-O3", "-shared", "-fPIC", "-std=c++17",
-                   "-o", _SO + ".tmp", _SRC]
+                   "-o", tmp, _SRC]
             try:
                 subprocess.run(cmd, check=True, capture_output=True,
                                timeout=120)
-                os.replace(_SO + ".tmp", _SO)
+                os.replace(tmp, _SO)
             except Exception:
                 _failed = True
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
                 return None
         try:
             lib = ctypes.CDLL(_SO)
@@ -70,13 +77,20 @@ def batch_gather(arr: np.ndarray, idx: np.ndarray) -> Optional[np.ndarray]:
     lib = _build()
     if lib is None or arr.ndim < 1 or arr.dtype == object:
         return None
-    arr = np.ascontiguousarray(arr)
+    if not arr.flags.c_contiguous:
+        # copying the whole dataset per batch would be slower than numpy's
+        # fancy indexing; fall back
+        return None
     idx64 = np.ascontiguousarray(np.asarray(idx, dtype=np.int64))
     if idx64.ndim != 1:
         return None
     out = np.empty((idx64.shape[0],) + arr.shape[1:], dtype=arr.dtype)
     row_bytes = int(arr.dtype.itemsize * np.prod(arr.shape[1:], dtype=np.int64))
     if row_bytes == 0 or arr.shape[0] == 0:
+        # match numpy semantics: any index into an empty dim is an error
+        if idx64.size and (arr.shape[0] == 0 or
+                           (idx64 >= arr.shape[0]).any() or (idx64 < 0).any()):
+            raise IndexError("batch_gather index out of range")
         return out
     rc = lib.ff_batch_gather(
         arr.ctypes.data_as(ctypes.c_char_p), arr.shape[0],

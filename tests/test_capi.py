@@ -1,0 +1,17 @@
+"""C API / embedding (C26; reference src/c/flexflow_c.cc): a C program
+drives model build -> compile -> fit -> forward through
+flexflow_tpu/capi (CPython embedded under the C surface)."""
+
+import subprocess
+import sys
+
+
+def test_c_example_trains():
+    out = subprocess.run(
+        [sys.executable, "tools/build_capi.py", "--run-example"],
+        cwd="/root/repo", capture_output=True, text=True, timeout=560)
+    assert out.returncode == 0, f"{out.stdout}\n{out.stderr[-3000:]}"
+    assert "C_API_OK" in out.stdout, out.stdout
+    assert "forward_ok dims=2 (32, 4)" in out.stdout, out.stdout
+    # the example itself asserts the loss improved across epochs
+    assert "final_loss=" in out.stdout

@@ -1,0 +1,85 @@
+"""MCMC legacy strategy search (C14c; reference FFModel::mcmc_optimize,
+src/runtime/model.cc:3286-3357): finds the known-good strategy on small
+graphs, agrees with the frontier DP where the DP is exact, and its strategy
+executes on the mesh."""
+
+import numpy as np
+
+from flexflow_tpu import FFConfig, FFModel, SGDOptimizer
+from flexflow_tpu.parallel.machine import MachineSpec
+from flexflow_tpu.search.dp import search_graph
+from flexflow_tpu.search.mcmc import assignment_cost, mcmc_optimize
+
+MACH = MachineSpec(mesh_axes={"data": 2, "model": 4}, chip="v5p")
+
+
+def _mlp_pair():
+    m = FFModel(FFConfig(batch_size=32))
+    x = m.create_tensor([32, 8192], name="x")
+    h = m.dense(x, 4 * 8192, activation="gelu", name="up")
+    m.dense(h, 8192, name="down")
+    return m
+
+
+def test_mcmc_finds_megatron_on_mlp_pair():
+    m = _mlp_pair()
+    st, stats = mcmc_optimize(m, MACH, budget=400, seed=0)
+    assert stats.best_cost < stats.init_cost  # beats pure data-parallel
+    assert st.op_shardings["up"].weights["kernel"] == [None, "model"]
+    assert st.op_shardings["down"].weights["kernel"] == ["model", None]
+
+
+def test_mcmc_matches_dp_optimum_on_chain():
+    """On a chain the frontier DP is exact; annealing with a generous budget
+    must land on the same cost."""
+    m = FFModel(FFConfig(batch_size=16))
+    x = m.create_tensor([16, 512], name="x")
+    h = m.dense(x, 1024, name="l0")
+    h = m.dense(h, 1024, name="l1")
+    m.dense(h, 256, name="l2")
+    dp_cost = search_graph(m, MACH, beam_width=10_000).cost
+    _, stats = mcmc_optimize(m, MACH, budget=600, seed=1)
+    assert abs(stats.best_cost - dp_cost) / dp_cost < 1e-9, \
+        (stats.best_cost, dp_cost)
+
+
+def test_mcmc_strategy_trains(devices):
+    cfg = FFConfig(batch_size=16, mesh_shape={"data": 2, "model": 4})
+    m = FFModel(cfg)
+    x = m.create_tensor([16, 256], name="x")
+    h = m.dense(x, 1024, activation="relu", name="up")
+    m.dense(h, 4, name="head")
+    mach = MachineSpec.detect({"data": 2, "model": 4})
+    st, _ = mcmc_optimize(m, mach, budget=100, seed=0)
+    cm_ = m.compile(SGDOptimizer(lr=0.01),
+                    loss_type="sparse_categorical_crossentropy", metrics=[])
+    cm_.strategy = st  # adopt the MCMC strategy
+    from flexflow_tpu.compiler.lowering import build_forward
+
+    cm_.forward_fn = build_forward(m.layers, m.input_tensors, cm_.outputs,
+                                   cm_.mesh, st)
+    cm_._build_steps()
+    cm_.init(seed=0)
+    rng = np.random.default_rng(0)
+    xv = rng.normal(size=(16, 256)).astype(np.float32)
+    yv = rng.integers(0, 4, size=(16,)).astype(np.int32)
+    h = cm_.fit(xv, yv, epochs=1, verbose=False)
+    assert np.isfinite(h[0]["loss"])
+
+
+def test_assignment_cost_matches_dp_edge_pricing():
+    """The MCMC evaluator prices the same chain the DP does: at the DP's
+    chosen assignment both evaluators agree."""
+    m = _mlp_pair()
+    r = search_graph(m, MACH)
+    from flexflow_tpu.core.graph import topo_order
+    from flexflow_tpu.search.candidates import layer_candidates
+
+    layers = topo_order(m.layers)
+    cand_lists = {l.name: layer_candidates(l, MACH, {32}) for l in layers}
+    assignment = {}
+    for l in layers:
+        names = [c.name for c in cand_lists[l.name]]
+        assignment[l.name] = names.index(r.choices[l.name].name)
+    cost = assignment_cost(layers, m.input_tensors, assignment, cand_lists, MACH)
+    assert abs(cost - r.cost) / r.cost < 1e-9, (cost, r.cost)
