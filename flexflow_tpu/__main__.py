@@ -20,12 +20,31 @@ from flexflow_tpu.config import FFConfig
 
 def main(argv=None):
     argv = list(sys.argv[1:] if argv is None else argv)
+    # the script is the first STANDALONE token (not a flag and not the value
+    # of a value-taking flag — e.g. `--machine-model-file mach.py train.py`
+    # must pick train.py)
+    value_flags = {
+        "-e", "--epochs", "-b", "--batch-size", "--lr", "--learning-rate",
+        "--wd", "--weight-decay", "--iterations", "--seed", "--mesh",
+        "--nodes", "-ll:tpu", "--workers-per-node", "--budget",
+        "--search-budget", "--alpha", "--search-alpha",
+        "--base-optimize-threshold", "--search-num-nodes",
+        "--search-num-workers", "--import", "--export",
+        "--substitution-json", "--machine-model-file", "--compute-dtype",
+        "--compgraph", "--profile-dir",
+    }
     script = None
-    for i, a in enumerate(argv):
-        if a.endswith(".py"):
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        if a.startswith("-"):
+            if "=" not in a and a in value_flags:
+                i += 1  # consume the flag's value token
+        else:
             script = a
             launcher_args, script_args = argv[:i], argv[i + 1:]
             break
+        i += 1
     if script is None:
         print("usage: python -m flexflow_tpu [flags] script.py [script args]\n"
               "flags: the FFConfig CLI (-b, --budget, --mesh data=4,model=2, ...)",

@@ -22,7 +22,15 @@ std::unordered_map<int64_t, PyObject*> g_models;    // FFModel objects
 std::unordered_map<int64_t, PyObject*> g_tensors;   // Tensor objects
 int64_t g_next_handle = 1;
 PyObject* g_config = nullptr;  // FFConfig from flexflow_init argv
-bool g_owns_interpreter = false;
+
+// Every public entry point holds the GIL for its duration: the host may
+// have initialized CPython itself and released the GIL (PyEval_SaveThread),
+// or may call from a non-Python thread — both are fatal without this.
+struct Gil {
+  PyGILState_STATE s;
+  Gil() : s(PyGILState_Ensure()) {}
+  ~Gil() { PyGILState_Release(s); }
+};
 
 int fail(const char* where) {
   std::string msg = where;
@@ -114,11 +122,7 @@ extern "C" {
 
 const char* flexflow_last_error(void) { return g_error.c_str(); }
 
-int flexflow_init(int argc, const char** argv) {
-  if (!Py_IsInitialized()) {
-    Py_Initialize();
-    g_owns_interpreter = true;
-  }
+static int init_impl(int argc, const char** argv) {
   // Platform override for embedding hosts (the sitecustomize may force the
   // TPU plugin; FLEXFLOW_PLATFORM=cpu forces the CPU backend instead).
   const char* plat = std::getenv("FLEXFLOW_PLATFORM");
@@ -151,7 +155,27 @@ int flexflow_init(int argc, const char** argv) {
   return 0;
 }
 
+int flexflow_init(int argc, const char** argv) {
+  bool created = false;
+  if (!Py_IsInitialized()) {
+    Py_Initialize();
+    created = true;
+  }
+  int rc;
+  {
+    Gil gil;
+    rc = init_impl(argc, argv);
+  }
+  // when WE created the interpreter this thread still holds the main-state
+  // GIL from Py_Initialize; release it so every later entry point's
+  // PyGILState_Ensure/Release pairs cleanly (and other host threads can
+  // call in)
+  if (created) PyEval_SaveThread();
+  return rc;
+}
+
 void flexflow_finalize(void) {
+  Gil gil;
   for (auto& kv : g_tensors) Py_XDECREF(kv.second);
   for (auto& kv : g_models) Py_XDECREF(kv.second);
   g_tensors.clear();
@@ -163,6 +187,7 @@ void flexflow_finalize(void) {
 }
 
 int flexflow_model_create(ff_model_t* out) {
+  Gil gil;
   PyObject* mod = PyImport_ImportModule("flexflow_tpu");
   if (!mod) return fail("import flexflow_tpu");
   PyObject* cls = PyObject_GetAttrString(mod, "FFModel");
@@ -177,6 +202,7 @@ int flexflow_model_create(ff_model_t* out) {
 }
 
 void flexflow_model_destroy(ff_model_t model) {
+  Gil gil;
   auto it = g_models.find(model);
   if (it != g_models.end()) {
     Py_XDECREF(it->second);
@@ -187,6 +213,7 @@ void flexflow_model_destroy(ff_model_t model) {
 int flexflow_tensor_create(ff_model_t model, int ndims, const int64_t* dims,
                            const char* dtype, const char* name,
                            ff_tensor_t* out) {
+  Gil gil;
   PyObject* m = get(g_models, model);
   if (!m) {
     g_error = "bad model handle";
@@ -207,6 +234,7 @@ int flexflow_tensor_create(ff_model_t model, int ndims, const int64_t* dims,
 int flexflow_dense(ff_model_t model, ff_tensor_t input, int64_t out_dim,
                    const char* activation, int use_bias, const char* name,
                    ff_tensor_t* out) {
+  Gil gil;
   PyObject* m = get(g_models, model);
   PyObject* t = get(g_tensors, input);
   if (!m || !t) {
@@ -240,6 +268,7 @@ int flexflow_conv2d(ff_model_t model, ff_tensor_t input, int out_channels,
                     int kernel_h, int kernel_w, int stride_h, int stride_w,
                     int padding_h, int padding_w, const char* activation,
                     int use_bias, const char* name, ff_tensor_t* out) {
+  Gil gil;
   PyObject* m = get(g_models, model);
   PyObject* t = get(g_tensors, input);
   if (!m || !t) {
@@ -262,6 +291,7 @@ int flexflow_pool2d(ff_model_t model, ff_tensor_t input, int kernel_h,
                     int kernel_w, int stride_h, int stride_w, int padding_h,
                     int padding_w, const char* pool_type, const char* name,
                     ff_tensor_t* out) {
+  Gil gil;
   PyObject* m = get(g_models, model);
   PyObject* t = get(g_tensors, input);
   if (!m || !t) {
@@ -280,6 +310,7 @@ int flexflow_pool2d(ff_model_t model, ff_tensor_t input, int kernel_h,
 int flexflow_embedding(ff_model_t model, ff_tensor_t input,
                        int64_t num_entries, int64_t out_dim, const char* name,
                        ff_tensor_t* out) {
+  Gil gil;
   PyObject* m = get(g_models, model);
   PyObject* t = get(g_tensors, input);
   if (!m || !t) {
@@ -302,21 +333,25 @@ int flexflow_embedding(ff_model_t model, ff_tensor_t input,
 
 int flexflow_relu(ff_model_t model, ff_tensor_t input, const char* name,
                   ff_tensor_t* out) {
+  Gil gil;
   return unary_builder(model, "relu", input, name, out);
 }
 
 int flexflow_flat(ff_model_t model, ff_tensor_t input, const char* name,
                   ff_tensor_t* out) {
+  Gil gil;
   return unary_builder(model, "flat", input, name, out);
 }
 
 int flexflow_softmax(ff_model_t model, ff_tensor_t input, const char* name,
                      ff_tensor_t* out) {
+  Gil gil;
   return unary_builder(model, "softmax", input, name, out);
 }
 
 int flexflow_add(ff_model_t model, ff_tensor_t a, ff_tensor_t b,
                  const char* name, ff_tensor_t* out) {
+  Gil gil;
   PyObject* m = get(g_models, model);
   PyObject* ta = get(g_tensors, a);
   PyObject* tb = get(g_tensors, b);
@@ -332,6 +367,7 @@ int flexflow_add(ff_model_t model, ff_tensor_t a, ff_tensor_t b,
 
 int flexflow_model_compile(ff_model_t model, const char* optimizer, double lr,
                            const char* loss) {
+  Gil gil;
   PyObject* m = get(g_models, model);
   if (!m) {
     g_error = "bad model handle";
@@ -345,10 +381,7 @@ int flexflow_model_compile(ff_model_t model, const char* optimizer, double lr,
   PyObject* cls = PyObject_GetAttrString(mod, cls_name);
   Py_DECREF(mod);
   if (!cls) return fail("optimizer class");
-  PyObject* opt =
-      (std::strcmp(cls_name, "AdamOptimizer") == 0)
-          ? PyObject_CallFunction(cls, "()")  // defaults; alpha set below
-          : PyObject_CallFunction(cls, "()");
+  PyObject* opt = PyObject_CallFunction(cls, nullptr);  // defaults; lr below
   Py_DECREF(cls);
   if (!opt) return fail("optimizer()");
   if (lr > 0) {
@@ -377,6 +410,7 @@ int flexflow_model_fit_f32(ff_model_t model, const float* x,
                            const int64_t* y_dims, int y_ndims,
                            const char* y_dtype, int epochs,
                            double* final_loss) {
+  Gil gil;
   PyObject* m = get(g_models, model);
   if (!m) {
     g_error = "bad model handle";
@@ -423,6 +457,7 @@ int flexflow_model_fit_f32(ff_model_t model, const float* x,
 int flexflow_model_forward_f32(ff_model_t model, const float* x,
                                const int64_t* x_dims, int x_ndims, float* out,
                                int64_t* out_dims, int* out_ndims) {
+  Gil gil;
   PyObject* m = get(g_models, model);
   if (!m) {
     g_error = "bad model handle";

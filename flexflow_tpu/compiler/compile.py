@@ -435,6 +435,19 @@ class CompiledModel:
                                             enable_fusion=self.cfg.enable_fusion)
             self._build_steps()
 
+    # ----------------------------------------------------------- checkpoint
+    def save_checkpoint(self, path: str) -> str:
+        """Full training-state checkpoint (params + optimizer state + BN
+        state + iteration) — orbax-backed; see runtime/checkpoint.py."""
+        from flexflow_tpu.runtime.checkpoint import save_checkpoint
+
+        return save_checkpoint(self, path)
+
+    def load_checkpoint(self, path: str) -> None:
+        from flexflow_tpu.runtime.checkpoint import restore_checkpoint
+
+        restore_checkpoint(self, path)
+
     # ------------------------------------------------------------- weights
     def parallel_view(self, layer_name: str, out_idx: int = 0):
         """The ParallelTensor view of a layer output under the compiled

@@ -45,6 +45,13 @@ class MachineSpec:
     dcn_bw: float = 25e9
     mxu_flop_overhead: float = 1.4  # achievable-fraction fudge: peak/this
     mxu_min_dim: int = 128  # lane width; shards thinner than this waste the MXU
+    # per-axis link topology (reference NetworkedMachineModel's topology
+    # generators, src/runtime/machine_model.cc / network.cc): "ring" = torus
+    # wraparound (full TPU slices; ring collectives use both directions, the
+    # preset bw), "line" = no wraparound (partial/twisted slices; ring
+    # algorithms lose the wrap link, halving effective bandwidth),
+    # "switch" = full-bisection fabric (DCN default).
+    axis_type: Dict[str, str] = dataclasses.field(default_factory=dict)
 
     def __post_init__(self):
         preset = CHIP_PRESETS.get(self.chip, CHIP_PRESETS["v5e"])
@@ -65,6 +72,18 @@ class MachineSpec:
     def axis_bw(self, axis: str) -> float:
         return self.ici_bw.get(axis, CHIP_PRESETS.get(self.chip, CHIP_PRESETS["v5e"])[3])
 
+    def axis_topology(self, axis: str) -> str:
+        if axis in self.axis_type:
+            return self.axis_type[axis]
+        return "switch" if axis in self.dcn_axes else "ring"
+
+    def axis_bw_eff(self, axis: str) -> float:
+        """Effective bandwidth for ring-style collectives on this axis: a
+        line (no torus wraparound) loses the wrap link, halving throughput;
+        rings and switched fabrics use the full figure."""
+        bw = self.axis_bw(axis)
+        return bw * 0.5 if self.axis_topology(axis) == "line" else bw
+
     # -------------------------------------------------------------- io
     def to_json(self) -> dict:
         return {
@@ -78,6 +97,7 @@ class MachineSpec:
             "dcn_bw": self.dcn_bw,
             "mxu_flop_overhead": self.mxu_flop_overhead,
             "mxu_min_dim": self.mxu_min_dim,
+            "axis_type": self.axis_type,
         }
 
     @staticmethod
@@ -93,6 +113,7 @@ class MachineSpec:
             dcn_bw=d.get("dcn_bw", 25e9),
             mxu_flop_overhead=d.get("mxu_flop_overhead", 1.4),
             mxu_min_dim=d.get("mxu_min_dim", 128),
+            axis_type=dict(d.get("axis_type", {})),
         )
 
     @staticmethod

@@ -1,0 +1,88 @@
+"""Checkpoint / resume — full training-state persistence.
+
+Reference gap filled (SURVEY §5d): the reference has NO checkpoint
+subsystem — only per-weight numpy get/set (parallel_tensor.h:164-169) and
+strategy export. The TPU rebuild keeps those (CompiledModel.get_weight/
+set_weight, Strategy.save/load) and adds what the survey prescribes: real
+orbax-backed checkpointing of params + optimizer state + non-trainable
+state + iteration counter, restored INTO the compiled shardings (orbax
+writes per-shard; multi-process runs coordinate through it natively).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+
+def _ckpt_dir(path: str) -> str:
+    return os.path.abspath(path)
+
+
+def save_checkpoint(cm, path: str) -> str:
+    """Persist a CompiledModel's full training state (params, optimizer
+    state, BN/running state, iteration, strategy) under `path`."""
+    import orbax.checkpoint as ocp
+
+    path = _ckpt_dir(path)
+    ckptr = ocp.StandardCheckpointer()
+    tree = {"params": cm.params, "opt_state": cm.opt_state}
+    ckptr.save(os.path.join(path, "tree"), tree, force=True)
+    ckptr.wait_until_finished()
+    # small host-side metadata travels as json (numpy state arrays included)
+    meta = {
+        "iteration": int(cm._iteration),
+        "state_keys": sorted(cm.state),
+        "strategy": cm.strategy.to_json(),
+    }
+    if jax.process_index() == 0:
+        with open(os.path.join(path, "meta.json"), "w") as f:
+            json.dump(meta, f)
+        if cm.state:
+            np.savez(os.path.join(path, "state.npz"),
+                     **{k: np.asarray(v) for k, v in cm.state.items()})
+    return path
+
+
+def restore_checkpoint(cm, path: str) -> None:
+    """Restore `save_checkpoint` output into a CompiledModel built from the
+    same model graph. Arrays land directly in the compiled shardings (the
+    live params/opt_state trees are the restore targets); the iteration
+    counter resumes, so LR schedules and recompile triggers continue."""
+    import orbax.checkpoint as ocp
+
+    path = _ckpt_dir(path)
+    if cm.params is None:
+        cm.init()
+    ckptr = ocp.StandardCheckpointer()
+    target = {"params": cm.params, "opt_state": cm.opt_state}
+    restored = ckptr.restore(os.path.join(path, "tree"), target)
+
+    # land every leaf in the LIVE tree's sharding; leaves whose live sharding
+    # is single-device (optimizer scalars from tx.init) are replicated over
+    # the mesh — orbax restores them committed to one device, which would
+    # clash with the mesh-wide arrays at the next train_step
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    def _placed(r, t):
+        sh = getattr(t, "sharding", None)
+        if isinstance(sh, NamedSharding):
+            return jax.device_put(r, sh)
+        return jax.device_put(r, NamedSharding(cm.mesh, PartitionSpec()))
+
+    cm.params = jax.tree_util.tree_map(_placed, restored["params"], cm.params)
+    cm.opt_state = jax.tree_util.tree_map(_placed, restored["opt_state"],
+                                          cm.opt_state)
+    with open(os.path.join(path, "meta.json")) as f:
+        meta = json.load(f)
+    cm._iteration = int(meta.get("iteration", 0))
+    state_file = os.path.join(path, "state.npz")
+    if os.path.exists(state_file):
+        import jax.numpy as jnp
+
+        loaded = np.load(state_file)
+        cm.state = {k: jnp.asarray(loaded[k]) for k in loaded.files}

@@ -44,10 +44,6 @@ def shard_bytes(spec: TensorSpec, dims: Sequence[DimSharding], machine: MachineS
     return spec.size_bytes // max(1, dims_degree(dims, machine))
 
 
-def _min_bw(axes, machine: MachineSpec) -> float:
-    return min((machine.axis_bw(a) for a in axes), default=machine.axis_bw("data"))
-
-
 def axis_degree(axes, machine: MachineSpec) -> int:
     deg = 1
     for a in axes:
@@ -55,25 +51,44 @@ def axis_degree(axes, machine: MachineSpec) -> int:
     return deg
 
 
-def all_gather_time(full_bytes: float, axes, machine: MachineSpec) -> float:
-    k = axis_degree(axes, machine)
-    if k <= 1:
+def _hier_gather_time(full_bytes: float, axes, machine: MachineSpec) -> float:
+    """Hierarchical multi-axis all-gather: one ring stage per axis, each
+    sending the accumulated shard (k_i - 1) hops at that axis's effective
+    bandwidth (reference NetworkedMachineModel's routed multi-hop cost,
+    machine_model.cc — here closed-form per torus axis). Axes are staged
+    fastest-first (DCN last), which is both the optimal schedule and a
+    CANONICAL order — the cost must not depend on set-iteration order of
+    the caller (string hashing is per-process randomized).
+    Reduces to (k-1)/k * bytes / bw for a single axis."""
+    k_total = axis_degree(axes, machine)
+    if k_total <= 1:
         return 0.0
-    return (k - 1) / k * full_bytes / _min_bw(axes, machine)
+    staged = sorted((a for a in axes if machine.mesh_axes.get(a, 1) > 1),
+                    key=lambda a: -machine.axis_bw_eff(a))
+    shard = full_bytes / k_total
+    t = 0.0
+    for a in staged:
+        k = machine.mesh_axes[a]
+        t += (k - 1) * shard / machine.axis_bw_eff(a)
+        shard *= k
+    return t
+
+
+def all_gather_time(full_bytes: float, axes, machine: MachineSpec) -> float:
+    return _hier_gather_time(full_bytes, axes, machine)
 
 
 def all_reduce_time(bytes_: float, axes, machine: MachineSpec) -> float:
-    k = axis_degree(axes, machine)
-    if k <= 1:
-        return 0.0
-    return 2.0 * (k - 1) / k * bytes_ / _min_bw(axes, machine)
+    # reduce-scatter down + all-gather up, each hierarchical
+    return 2.0 * _hier_gather_time(bytes_, axes, machine)
 
 
 def all_to_all_time(shard_bytes_: float, axes, machine: MachineSpec) -> float:
     k = axis_degree(axes, machine)
     if k <= 1:
         return 0.0
-    return (k - 1) / k * shard_bytes_ / _min_bw(axes, machine)
+    bw = min(machine.axis_bw_eff(a) for a in axes if machine.mesh_axes.get(a, 1) > 1)
+    return (k - 1) / k * shard_bytes_ / bw
 
 
 def compute_time(flops: float, hbm_bytes: float, machine: MachineSpec,

@@ -1,0 +1,65 @@
+"""Machine-model topology fidelity (C13; reference NetworkedMachineModel,
+src/runtime/machine_model.cc): hierarchical multi-axis collectives, torus
+(ring) vs line wraparound, and DCN-staged transfers."""
+
+import pytest
+
+from flexflow_tpu.parallel.machine import MachineSpec
+from flexflow_tpu.search import cost_model as cm
+
+
+def test_single_axis_formula_unchanged():
+    m = MachineSpec(mesh_axes={"data": 8}, chip="v5p")
+    b = 8 * 1024 * 1024
+    expect = (8 - 1) / 8 * b / m.axis_bw("data")
+    assert cm.all_gather_time(b, ("data",), m) == pytest.approx(expect)
+    assert cm.all_reduce_time(b, ("data",), m) == pytest.approx(2 * expect)
+
+
+def test_multi_axis_gather_is_hierarchical_not_min_bw():
+    """Gathering over (ici, dcn) stages: most hops ride ICI at small shard
+    sizes; only the final inter-slice stage pays DCN — strictly cheaper than
+    pricing ALL bytes at the min bandwidth (the round-3 model), strictly
+    dearer than pretending DCN is free."""
+    m = MachineSpec(mesh_axes={"slice": 2, "data": 8}, chip="v5p",
+                    dcn_axes=("slice",))
+    b = 64 * 1024 * 1024
+    t = cm.all_gather_time(b, ("data", "slice"), m)
+    t_min_bw = (16 - 1) / 16 * b / m.axis_bw("slice")  # old model
+    shard = b / 16
+    t_expected = (7 * shard / m.axis_bw("data")
+                  + 1 * (shard * 8) / m.axis_bw("slice"))
+    assert t == pytest.approx(t_expected)
+    assert t < t_min_bw
+    assert t > 1 * (b / 2) / m.axis_bw("slice") * 0.99  # DCN stage is real
+
+
+def test_line_axis_halves_effective_bandwidth():
+    ring = MachineSpec(mesh_axes={"data": 8}, chip="v5p")
+    line = MachineSpec(mesh_axes={"data": 8}, chip="v5p",
+                       axis_type={"data": "line"})
+    b = 1024 * 1024
+    assert cm.all_gather_time(b, ("data",), line) == pytest.approx(
+        2 * cm.all_gather_time(b, ("data",), ring))
+    # topology survives the machine-model file round trip
+    rt = MachineSpec.from_json(line.to_json())
+    assert rt.axis_topology("data") == "line"
+    assert rt.axis_bw_eff("data") == pytest.approx(line.axis_bw("data") / 2)
+
+
+def test_dcn_axis_defaults_to_switch_topology():
+    m = MachineSpec(mesh_axes={"s": 2, "data": 4}, chip="v5p", dcn_axes=("s",))
+    assert m.axis_topology("s") == "switch"
+    assert m.axis_topology("data") == "ring"
+    # switch fabric keeps full bandwidth (no wrap penalty)
+    assert m.axis_bw_eff("s") == m.axis_bw("s")
+
+
+def test_grad_sync_over_two_axes_uses_hierarchy():
+    from flexflow_tpu.core.tensor import TensorSpec
+
+    m = MachineSpec(mesh_axes={"a": 4, "b": 2}, chip="v5p")
+    spec = TensorSpec((1024, 1024))
+    t = cm.grad_sync_time({"w": spec}, {"w": [None, None]}, m, ["a", "b"])
+    assert t == pytest.approx(2 * cm._hier_gather_time(
+        spec.size_bytes, ("a", "b"), m))
