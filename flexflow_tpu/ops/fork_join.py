@@ -86,7 +86,10 @@ def _branch_weight_dicts(layer: Layer, weights: Dict) -> List[Dict[str, Dict]]:
         for k, v in weights.items():
             if not k.startswith(prefix):
                 continue
-            lname, wname = k[len(prefix):].rsplit(".", 1)
+            # split at the FIRST dot: the remainder is the sub-layer's own
+            # weight name, which itself contains dots when the sub-layer is
+            # a nested fork_join composite ("b0.inner.b0.i1.kernel")
+            lname, wname = k[len(prefix):].split(".", 1)
             d.setdefault(lname, {})[wname] = v
         out.append(d)
     return out

@@ -1,0 +1,173 @@
+"""Generic fork-join detection (the other half of C11: the reference's
+nonsequence splits apply to ANY parallel branches, not just user-marked
+regions): the fuse_fork_joins pass finds reconverging chains in a plain
+layer graph, rewrites them into FORK_JOIN composites, preserves numerics,
+and makes them placeable on disjoint chips by the search."""
+
+import numpy as np
+
+from flexflow_tpu import FFConfig, FFModel, SGDOptimizer
+from flexflow_tpu.compiler.passes import find_fork_join_regions, fuse_fork_joins
+from flexflow_tpu.ops.op_type import OperatorType
+from flexflow_tpu.parallel.machine import MachineSpec
+from flexflow_tpu.search.dp import search_graph
+
+
+def _branchy(hidden=64, join="add"):
+    m = FFModel(FFConfig(batch_size=16, mesh_shape={"data": 4, "model": 2},
+                         only_data_parallel=True))
+    x = m.create_tensor([16, 32], name="x")
+    a = m.dense(x, hidden, activation="relu", name="a1")
+    a = m.dense(a, 48, name="a2")
+    b = m.dense(x, hidden, activation="gelu", name="b1")
+    b = m.dense(b, 48, name="b2")
+    j = m.add(a, b, name="j") if join == "add" else \
+        m.concat([a, b], axis=-1, name="j")
+    m.dense(j, 4, name="head")
+    return m
+
+
+def test_detects_and_fuses_add_join():
+    m = _branchy()
+    regions = find_fork_join_regions(m)
+    assert len(regions) == 1
+    assert [l.name for l in regions[0]["chains"][0]] == ["a1", "a2"]
+    assert fuse_fork_joins(m) == 1
+    types = [l.op_type for l in m.layers]
+    assert OperatorType.FORK_JOIN in types
+    assert len(m.layers) == 2  # fj + head
+    fj = next(l for l in m.layers if l.op_type is OperatorType.FORK_JOIN)
+    assert "b0.a1.kernel" in fj.weight_specs
+    assert fj.outputs[0].spec.shape == (16, 48)
+
+
+def test_no_false_positives():
+    # residual (fork feeds the join directly) and diverging-only graphs
+    m = FFModel(FFConfig(batch_size=8))
+    x = m.create_tensor([8, 32], name="x")
+    h = m.dense(x, 32, name="d")
+    m.add(h, x, name="res")          # residual: NOT a balanced fork-join
+    m2 = FFModel(FFConfig(batch_size=8))
+    x2 = m2.create_tensor([8, 32], name="x")
+    m2.dense(x2, 16, name="p")       # two heads, never reconverge
+    m2.dense(x2, 8, name="q")
+    assert fuse_fork_joins(m) == 0
+    assert fuse_fork_joins(m2) == 0
+
+
+def test_cascaded_regions_fuse_and_compile(devices):
+    """Region 2's fork is region 1's join output: fusing must re-detect
+    against the mutated graph, not splice a deleted tensor (round-4 review
+    crash repro)."""
+    m = FFModel(FFConfig(batch_size=8, only_data_parallel=True))
+    x = m.create_tensor([8, 16], name="x")
+    a = m.dense(x, 32, name="r1a")
+    b = m.dense(x, 32, name="r1b")
+    j1 = m.add(a, b, name="j1")
+    c = m.dense(j1, 32, name="r2a")
+    d = m.dense(j1, 32, name="r2b")
+    j2 = m.add(c, d, name="j2")
+    m.dense(j2, 4, name="head")
+    assert fuse_fork_joins(m) == 2
+    cm = m.compile(SGDOptimizer(lr=0.01), loss_type="mean_squared_error",
+                   metrics=[])
+    cm.init(seed=0)
+    out = cm.forward(np.zeros((8, 16), np.float32))
+    assert np.asarray(out).shape == (8, 4)
+
+
+def test_nested_hand_built_fork_join_survives():
+    """A hand-built fork_join inside a detected chain keeps its branches
+    attribute through the rebuild (round-4 review crash repro)."""
+    m = FFModel(FFConfig(batch_size=8, only_data_parallel=True))
+    x = m.create_tensor([8, 16], name="x")
+    a = m.fork_join(x, [lambda mm, t: mm.dense(t, 16, name="i1"),
+                        lambda mm, t: mm.dense(t, 16, name="i2")],
+                    join="add", name="inner")
+    a = m.dense(a, 32, name="a2")
+    b = m.dense(x, 32, name="b1")
+    m.add(a, b, name="j")
+    assert fuse_fork_joins(m) == 1
+    cm = m.compile(SGDOptimizer(lr=0.01), loss_type="mean_squared_error",
+                   metrics=[])
+    cm.init(seed=0)  # lowering the nested composite needs .branches
+    out = cm.forward(np.zeros((8, 16), np.float32))
+    assert np.asarray(out).shape == (8, 32)
+
+
+def test_contract_violating_region_skipped():
+    """Branches that break the fork_join contract (batch-changing reshape)
+    are SKIPPED, not crashed on (round-4 review crash repro)."""
+    m = FFModel(FFConfig(batch_size=16, only_data_parallel=True))
+    x = m.create_tensor([16, 4, 8], name="x")
+    a = m.dense(m.reshape(x, [8, 64], name="ra"), 32, name="da")
+    b = m.dense(m.reshape(x, [8, 64], name="rb"), 32, name="db")
+    m.add(a, b, name="j")
+    assert fuse_fork_joins(m) == 0  # no crash, nothing mutated
+    assert any(l.name == "ra" for l in m.layers)
+
+
+def test_auto_named_branch_layers_renamed_positionally():
+    def build():
+        m = FFModel(FFConfig(batch_size=8))
+        x = m.create_tensor([8, 16], name="x")
+        a = m.dense(m.dense(x, 32), 16)   # auto names
+        b = m.dense(x, 16)
+        m.add(a, b, name="j")
+        fuse_fork_joins(m)
+        fj = next(l for l in m.layers
+                  if l.op_type is OperatorType.FORK_JOIN)
+        return sorted(fj.weight_specs)
+
+    assert build() == build()  # no process-global guids in the keys
+
+
+def test_fused_numerics_match_unfused(devices):
+    rng = np.random.default_rng(0)
+    xv = rng.normal(size=(16, 32)).astype(np.float32)
+
+    m1 = _branchy(join="concat")
+    cm1 = m1.compile(SGDOptimizer(lr=0.01), loss_type="mean_squared_error",
+                     metrics=[])
+    cm1.init(seed=0)
+    ref = np.asarray(cm1.forward(xv))
+
+    m2 = _branchy(join="concat")
+    assert fuse_fork_joins(m2) == 1
+    cm2 = m2.compile(SGDOptimizer(lr=0.01), loss_type="mean_squared_error",
+                     metrics=[])
+    cm2.init(seed=0)
+    fj = next(l for l in m2.layers if l.op_type is OperatorType.FORK_JOIN)
+    for bi, branch in enumerate(("a", "b")):
+        for li in (1, 2):
+            for w in ("kernel", "bias"):
+                cm2.set_weight(fj.name, f"b{bi}.{branch}{li}.{w}",
+                               cm1.get_weight(f"{branch}{li}", w))
+    cm2.set_weight("head", "kernel", cm1.get_weight("head", "kernel"))
+    cm2.set_weight("head", "bias", cm1.get_weight("head", "bias"))
+    got = np.asarray(cm2.forward(xv))
+    np.testing.assert_allclose(got, ref, rtol=2e-5, atol=2e-5)
+
+
+def test_fused_region_becomes_placeable(devices):
+    """After fusion the search can place the branches on disjoint chips —
+    the generic nonsequence-split path end to end."""
+    m = _branchy(hidden=4096)
+    assert fuse_fork_joins(m) == 1
+    mach = MachineSpec(mesh_axes={"data": 4, "model": 2}, chip="v5p")
+    r = search_graph(m, mach)
+    fj = next(l for l in m.layers if l.op_type is OperatorType.FORK_JOIN)
+    assert r.choices[fj.name].name == "inter:model", r.choices[fj.name].name
+
+    # and it trains placed
+    m.config.only_data_parallel = False
+    m.config.search_budget = 8
+    cm = m.compile(SGDOptimizer(lr=0.01), loss_type="mean_squared_error",
+                   metrics=[])
+    assert cm.strategy.sharding_for(fj.name).attrs.get("placement") == "model"
+    cm.init(seed=0)
+    rng = np.random.default_rng(0)
+    xv = rng.normal(size=(16, 32)).astype(np.float32)
+    yv = rng.normal(size=(16, 4)).astype(np.float32)
+    h = cm.fit(xv, yv, epochs=1, verbose=False)
+    assert np.isfinite(h[0]["loss"])
