@@ -15,6 +15,7 @@ create the label tensor, init optimizer + NCCL. The TPU-native pipeline:
 
 from __future__ import annotations
 
+import logging
 import time
 from functools import partial
 from typing import Any, Dict, List, Optional, Sequence
@@ -107,10 +108,25 @@ def compile_model(model, optimizer, loss_type: LossType, metrics: Sequence[Metri
     cfg = model.config
     if cfg.machine_model_file:
         machine = MachineSpec.from_file(cfg.machine_model_file)
+    elif not cfg.mesh_shape and cfg.num_nodes > 1:
+        # --nodes/-ll:tpu (reference machine description): nodes form a
+        # DCN-crossing axis, per-node workers the intra-node data axis
+        workers = cfg.workers_per_node or max(
+            1, len(jax.devices()) // cfg.num_nodes)
+        machine = MachineSpec.detect({"node": cfg.num_nodes, "data": workers},
+                                     dcn_axes=("node",))
     else:
         machine = MachineSpec.detect(cfg.mesh_shape)
+    level = getattr(logging, cfg.log_level.upper(), None)
+    if level is None:
+        raise ValueError(f"unknown log_level {cfg.log_level!r}")
+    lg = logging.getLogger("flexflow_tpu")
+    if lg.level == logging.NOTSET:  # never clobber application logging config
+        lg.setLevel(level)
     mesh = build_mesh(machine)
     strategy = _pick_strategy(model, machine)
+    logging.getLogger("flexflow_tpu").info(
+        "compile: mesh=%s strategy=%s", dict(machine.mesh_axes), strategy.name)
     _overlay_parallel_ops(model, strategy)
     if cfg.export_strategy_file:
         strategy.save(cfg.export_strategy_file)
@@ -140,6 +156,7 @@ class CompiledModel:
 
         self.forward_fn = build_forward(model.layers, model.input_tensors, outputs,
                                         mesh, strategy,
+                                        seq_length=self.cfg.seq_length or None,
                                         compute_dtype=self.cfg.compute_dtype,
                                         enable_fusion=self.cfg.enable_fusion)
         self._build_steps()
@@ -222,6 +239,10 @@ class CompiledModel:
         loss_type, metric_types = self.loss_type, self.metrics
         tx = self.tx
         remat = self.cfg.remat
+        # --allow-tensor-op-math-conversion (reference config.h / cuBLAS
+        # tensor-op gate ≙ the MXU's reduced-precision passes): when off,
+        # every dot runs at HIGHEST precision (f32 accumulation passes)
+        precision = None if self.cfg.allow_tensor_op_math_conversion else "highest"
 
         def train_step(params, opt_state, state, inputs, label, rng):
             def loss_fn(p):
@@ -250,9 +271,22 @@ class CompiledModel:
             outs, _ = forward_fn(params, state, inputs, False, jax.random.PRNGKey(0))
             return outs
 
-        self.train_step = jax.jit(train_step, donate_argnums=(0, 1, 2))
-        self.eval_step = jax.jit(eval_step)
-        self.infer_step = jax.jit(infer)
+        def _wrap(fn):
+            if precision is None:
+                return fn
+
+            def wrapped(*a):
+                with jax.default_matmul_precision(precision):
+                    return fn(*a)
+
+            return wrapped
+
+        # donate_state=False keeps the previous params/opt/state buffers
+        # alive after each step (debugging / external references)
+        donate = (0, 1, 2) if self.cfg.donate_state else ()
+        self.train_step = jax.jit(_wrap(train_step), donate_argnums=donate)
+        self.eval_step = jax.jit(_wrap(eval_step))
+        self.infer_step = jax.jit(_wrap(infer))
 
     def _coerce_batch(self, batch_size: Optional[int]) -> int:
         # batch must match the traced graph-input batch dim (XLA static shapes)
@@ -431,6 +465,7 @@ class CompiledModel:
             alter(self)
             self.forward_fn = build_forward(self.model.layers, self.model.input_tensors,
                                             self.outputs, self.mesh, self.strategy,
+                                            seq_length=self.cfg.seq_length or None,
                                             compute_dtype=self.cfg.compute_dtype,
                                             enable_fusion=self.cfg.enable_fusion)
             self._build_steps()

@@ -22,6 +22,11 @@ class FFConfig:
     learning_rate: float = 0.01
     weight_decay: float = 1e-4
     iterations: int = 0  # 0 = derive from dataset size
+    # FFIterationConfig.seq_length analog (reference config.h:162-167):
+    # truncate seq-aware ops (batch_matmul a/b_seq_length_dim) to this many
+    # positions. The reference varies it per iteration; XLA static shapes
+    # make it a compile-time choice here (0 = full length).
+    seq_length: int = 0
     seed: int = 0
     # machine: logical mesh. Empty -> 1D mesh over all visible devices ("data",).
     mesh_shape: Dict[str, int] = dataclasses.field(default_factory=dict)
@@ -74,6 +79,7 @@ class FFConfig:
         p.add_argument("--lr", "--learning-rate", dest="lr", type=float, default=0.01)
         p.add_argument("--wd", "--weight-decay", dest="wd", type=float, default=1e-4)
         p.add_argument("--iterations", type=int, default=0)
+        p.add_argument("--seq-length", type=int, default=0)
         p.add_argument("--seed", type=int, default=0)
         p.add_argument("--mesh", type=str, default="", help="e.g. data=4,model=2")
         p.add_argument("--nodes", type=int, default=1)
@@ -114,6 +120,7 @@ class FFConfig:
             learning_rate=args.lr,
             weight_decay=args.wd,
             iterations=args.iterations,
+            seq_length=args.seq_length,
             seed=args.seed,
             mesh_shape=mesh,
             num_nodes=args.nodes,

@@ -100,9 +100,15 @@ class FFModel:
 
     def batch_matmul(self, A: Tensor, B: Tensor, a_seq_length_dim: int = -1,
                      b_seq_length_dim: int = -1, name=None) -> Tensor:
+        # FFIterationConfig.seq_length analog: captured at BUILD time so
+        # shape inference sees the truncated lengths and downstream specs
+        # stay consistent (XLA static shapes; the reference truncates at
+        # runtime over full-size regions instead)
         return self._add_layer(
             OperatorType.BATCHMATMUL,
-            {"a_seq_length_dim": a_seq_length_dim, "b_seq_length_dim": b_seq_length_dim},
+            {"a_seq_length_dim": a_seq_length_dim,
+             "b_seq_length_dim": b_seq_length_dim,
+             "seq_length": int(self.config.seq_length or 0)},
             [A, B], name)[0]
 
     def multihead_attention(self, query: Tensor, key: Tensor, value: Tensor,

@@ -48,23 +48,36 @@ register_op(OperatorType.LINEAR, _linear_infer, _linear_lower, _linear_flops)
 
 def _bmm_infer(layer: Layer):
     a, b = [t.spec for t in layer.inputs]
-    if a.shape[:-2] != b.shape[:-2] or a.shape[-1] != b.shape[-2]:
+    ash, bsh = _bmm_trunc_shapes(layer, a.shape, b.shape)
+    if ash[:-2] != bsh[:-2] or ash[-1] != bsh[-2]:
         raise ValueError(f"batch_matmul shape mismatch {a} @ {b}")
-    return [a.with_shape(a.shape[:-1] + (b.shape[-1],))]
+    return [a.with_shape(ash[:-1] + (bsh[-1],))]
+
+
+def _bmm_trunc_shapes(layer, ash, bsh):
+    """Seq-length truncation (reference batch_matmul a/b_seq_length_dim,
+    include/flexflow/model.h:481-485 + FFIterationConfig.seq_length,
+    config.h:162-167): applied at shape-inference time so downstream specs
+    agree with the runtime slice."""
+    sl = layer.params.get("seq_length") or 0
+    ash, bsh = list(ash), list(bsh)
+    if sl > 0:
+        ad = layer.params.get("a_seq_length_dim", -1)
+        bd = layer.params.get("b_seq_length_dim", -1)
+        if ad >= 0 and ash[ad] > sl:
+            ash[ad] = sl
+        if bd >= 0 and bsh[bd] > sl:
+            bsh[bd] = sl
+    return tuple(ash), tuple(bsh)
 
 
 def _bmm_lower(layer: Layer, inputs, weights, ctx):
     a, b = inputs
-    # seq-length truncation (reference: batch_matmul a/b_seq_length_dim,
-    # include/flexflow/model.h:481-485): a static slice when configured.
-    sl = ctx.seq_length
-    if sl is not None:
-        if layer.params.get("a_seq_length_dim", -1) >= 0:
-            d = layer.params["a_seq_length_dim"]
-            a = jnp.take(a, jnp.arange(sl), axis=d) if a.shape[d] > sl else a
-        if layer.params.get("b_seq_length_dim", -1) >= 0:
-            d = layer.params["b_seq_length_dim"]
-            b = jnp.take(b, jnp.arange(sl), axis=d) if b.shape[d] > sl else b
+    ash, bsh = _bmm_trunc_shapes(layer, a.shape, b.shape)
+    if tuple(a.shape) != ash:
+        a = a[tuple(slice(0, s) for s in ash)]
+    if tuple(b.shape) != bsh:
+        b = b[tuple(slice(0, s) for s in bsh)]
     return [jnp.matmul(a, b)]
 
 

@@ -17,21 +17,31 @@ from flexflow_tpu.parallel.sharding import OpSharding, Strategy
 
 
 def data_parallel_strategy(model, machine: MachineSpec, axis: str = "data") -> Strategy:
-    """Shard dim 0 of every batch-sized tensor over `axis`, replicate weights.
+    """Shard dim 0 of every batch-sized tensor over the batch axes,
+    replicate weights.
 
     Batch identification is by size: a leading dim equal to the global batch
-    (graph-input dim 0). Sharding constraints never change semantics, so a
-    miss here only costs layout, never correctness.
+    (graph-input dim 0). The batch rides ALL sample axes — on a
+    {node, data} multi-node mesh (--nodes, compile.py) both axes shard the
+    batch, so nodes split samples instead of replicating them. Sharding
+    constraints never change semantics, so a miss here only costs layout,
+    never correctness.
     """
-    if axis not in machine.mesh_axes:
-        axis = next(iter(machine.mesh_axes))
-    degree = machine.mesh_axes[axis]
+    from flexflow_tpu.search.candidates import _batch_axes
+
+    axes = _batch_axes(machine) or [axis]
+    if not all(a in machine.mesh_axes for a in axes):
+        axes = [next(iter(machine.mesh_axes))]
+    spec = tuple(axes) if len(axes) > 1 else axes[0]
+    degree = 1
+    for a in axes:
+        degree *= machine.mesh_axes[a]
     batch_sizes = {t.shape[0] for t in model.input_tensors if t.ndim > 0}
 
     def dims_for(shape) -> List:
         dims: List = [None] * len(shape)
         if shape and shape[0] in batch_sizes and shape[0] % degree == 0:
-            dims[0] = axis
+            dims[0] = spec
         return dims
 
     st = Strategy(mesh_axes=dict(machine.mesh_axes), name="data_parallel")

@@ -122,9 +122,11 @@ class MachineSpec:
             return MachineSpec.from_json(json.load(f))
 
     @staticmethod
-    def detect(mesh_axes: Optional[Dict[str, int]] = None) -> "MachineSpec":
+    def detect(mesh_axes: Optional[Dict[str, int]] = None,
+               dcn_axes: Tuple[str, ...] = ()) -> "MachineSpec":
         """Build a spec for the visible devices (the reference's machine
-        discovery in FFConfig; src/runtime/model.cc FFConfig ctor)."""
+        discovery in FFConfig; src/runtime/model.cc FFConfig ctor).
+        `dcn_axes` marks cross-slice axes so their bandwidth binds to DCN."""
         devs = jax.devices()
         chip = "cpu-sim" if devs[0].platform == "cpu" else "v5e"
         kind = getattr(devs[0], "device_kind", "").lower()
@@ -134,7 +136,8 @@ class MachineSpec:
             chip = "v4"
         if not mesh_axes:
             mesh_axes = {"data": len(devs)}
-        return MachineSpec(mesh_axes=dict(mesh_axes), chip=chip)
+        return MachineSpec(mesh_axes=dict(mesh_axes), chip=chip,
+                           dcn_axes=tuple(dcn_axes))
 
 
 def build_mesh(spec: MachineSpec) -> jax.sharding.Mesh:

@@ -96,8 +96,12 @@ def candidate_attrs(cand: "Candidate") -> Dict[str, str]:
 
 
 def _batch_axes(machine: MachineSpec) -> List[str]:
-    if "data" in machine.mesh_axes:
-        return ["data"]
+    """Axes the batch dim rides: "data" plus the multi-node sample axis
+    ("node", --nodes in compile.py) when present — nodes split samples,
+    they don't replicate them."""
+    axes = [a for a in ("node", "data") if a in machine.mesh_axes]
+    if axes:
+        return axes
     return [next(iter(machine.mesh_axes))] if machine.mesh_axes else []
 
 
@@ -108,8 +112,17 @@ def _model_axes(machine: MachineSpec) -> List[str]:
 
 def _dp_dims(shape, machine: MachineSpec, batch_sizes) -> List[DimSharding]:
     dims: List[DimSharding] = [None] * len(shape)
-    for ax in _batch_axes(machine):
-        if shape and shape[0] in batch_sizes and shape[0] % machine.mesh_axes[ax] == 0:
+    if not shape or shape[0] not in batch_sizes:
+        return dims
+    axes = _batch_axes(machine)
+    deg = 1
+    for a in axes:
+        deg *= machine.mesh_axes[a]
+    if len(axes) > 1 and shape[0] % deg == 0:
+        dims[0] = tuple(axes)  # batch over node AND data
+        return dims
+    for ax in axes:
+        if shape[0] % machine.mesh_axes[ax] == 0:
             dims[0] = ax
             break
     return dims

@@ -1,0 +1,120 @@
+"""The remaining runtime-config fields are wired (zero
+accepted-and-ignored, extending round-2's bar to every field): num_nodes/
+workers_per_node machine description, donate_state, tensor-op math gate,
+log_level, seq_length (tested in test_core_graph)."""
+
+import logging
+
+import numpy as np
+import pytest
+
+from flexflow_tpu import FFConfig, FFModel, SGDOptimizer
+
+
+def _tiny(cfg):
+    m = FFModel(cfg)
+    x = m.create_tensor([16, 8], name="x")
+    m.dense(x, 4, name="fc")
+    return m
+
+
+def test_num_nodes_builds_dcn_node_axis(devices):
+    cfg = FFConfig(batch_size=16, num_nodes=2, workers_per_node=4,
+                   only_data_parallel=True)
+    m = _tiny(cfg)
+    cm = m.compile(SGDOptimizer(lr=0.01),
+                   loss_type="sparse_categorical_crossentropy", metrics=[])
+    assert dict(cm.machine.mesh_axes) == {"node": 2, "data": 4}
+    assert cm.machine.dcn_axes == ("node",)
+    assert cm.machine.axis_bw("node") == cm.machine.dcn_bw  # DCN-priced
+
+
+def test_donate_state_false_keeps_buffers(devices):
+    import jax
+
+    cfg = FFConfig(batch_size=16, only_data_parallel=True, donate_state=False)
+    m = _tiny(cfg)
+    cm = m.compile(SGDOptimizer(lr=0.01),
+                   loss_type="sparse_categorical_crossentropy", metrics=[])
+    cm.init(seed=0)
+    old_params = cm.params
+    x = np.zeros((16, 8), np.float32)
+    y = np.zeros((16,), np.int32)
+    cm.train_step(cm.params, cm.opt_state, cm.state, [jax.device_put(x)],
+                  jax.device_put(y), jax.random.PRNGKey(0))
+    # without donation the original buffers remain readable
+    _ = float(np.asarray(old_params["fc"]["kernel"]).sum())
+
+
+def test_tensor_op_math_gate_sets_matmul_precision(devices):
+    import jax
+
+    def jaxpr_for(allow):
+        cfg = FFConfig(batch_size=16, only_data_parallel=True,
+                       allow_tensor_op_math_conversion=allow)
+        m = _tiny(cfg)
+        cm = m.compile(SGDOptimizer(lr=0.01),
+                       loss_type="sparse_categorical_crossentropy",
+                       metrics=[])
+        cm.init(seed=0)
+        x = [np.zeros((16, 8), np.float32)]
+        y = np.zeros((16,), np.int32)
+        return str(jax.make_jaxpr(
+            lambda p, o, s: cm.train_step.__wrapped__(p, o, s, x, y,
+                                                      jax.random.PRNGKey(0))
+        )(cm.params, cm.opt_state, cm.state))
+
+    assert "Precision.HIGHEST" in jaxpr_for(False)
+    assert "Precision.HIGHEST" not in jaxpr_for(True)
+
+
+def test_log_level_wired(devices, caplog):
+    lg = logging.getLogger("flexflow_tpu")
+    old = lg.level
+    try:
+        # pristine logger: cfg.log_level applies
+        lg.setLevel(logging.NOTSET)
+        m = _tiny(FFConfig(batch_size=16, only_data_parallel=True,
+                           log_level="debug"))
+        m.compile(SGDOptimizer(lr=0.01),
+                  loss_type="sparse_categorical_crossentropy", metrics=[])
+        assert lg.level == logging.DEBUG
+        # application config wins: an explicit level is never clobbered
+        lg.setLevel(logging.WARNING)
+        m2 = _tiny(FFConfig(batch_size=16, only_data_parallel=True,
+                            log_level="info"))
+        m2.compile(SGDOptimizer(lr=0.01),
+                   loss_type="sparse_categorical_crossentropy", metrics=[])
+        assert lg.level == logging.WARNING
+        # invalid names fail loud instead of silently meaning INFO
+        with pytest.raises(ValueError):
+            _tiny(FFConfig(batch_size=16, only_data_parallel=True,
+                           log_level="trace")).compile(
+                SGDOptimizer(lr=0.01),
+                loss_type="sparse_categorical_crossentropy", metrics=[])
+        # the compile log line exists
+        with caplog.at_level(logging.INFO, logger="flexflow_tpu"):
+            m3 = _tiny(FFConfig(batch_size=16, only_data_parallel=True))
+            m3.compile(SGDOptimizer(lr=0.01),
+                       loss_type="sparse_categorical_crossentropy", metrics=[])
+        assert any("compile: mesh=" in r.getMessage() for r in caplog.records)
+    finally:
+        lg.setLevel(old)
+
+
+def test_multi_node_mesh_shards_batch_over_node_axis(devices):
+    """--nodes must buy sample parallelism: the batch dim rides BOTH the
+    node (DCN) axis and the intra-node data axis (round-4 review fix — a
+    replicated node axis would make --nodes 2 a no-op)."""
+    cfg = FFConfig(batch_size=16, num_nodes=2, workers_per_node=4,
+                   only_data_parallel=True)
+    m = _tiny(cfg)
+    cm = m.compile(SGDOptimizer(lr=0.01),
+                   loss_type="sparse_categorical_crossentropy", metrics=[])
+    dims = cm.strategy.input_shardings["x"]
+    assert dims[0] in (("node", "data"), ["node", "data"]), dims
+    pv = cm.parallel_view("fc")
+    assert pv.dims[0].degree == 8  # 2 nodes x 4 workers all split samples
+    cm.init(seed=0)
+    out = cm.forward(np.zeros((16, 8), np.float32))
+    assert np.asarray(out).shape == (16, 4)

@@ -101,3 +101,32 @@ def test_reshape_errors():
     x = m.create_tensor([4, 8])
     with pytest.raises(ValueError):
         m.reshape(x, [5, 7])
+
+
+def test_seq_length_truncates_batch_matmul(devices):
+    """FFIterationConfig.seq_length analog (reference config.h:162-167 +
+    batch_matmul a/b_seq_length_dim, model.h:481-485): the configured
+    truncation reaches the lowering."""
+    import numpy as np
+
+    from flexflow_tpu import FFConfig, FFModel
+
+    def build(seq_length):
+        cfg = FFConfig(batch_size=2, only_data_parallel=True,
+                       seq_length=seq_length)
+        m = FFModel(cfg)
+        a = m.create_tensor([2, 8, 4], name="a")
+        b = m.create_tensor([2, 4, 8], name="b")
+        m.batch_matmul(a, b, a_seq_length_dim=1, name="bmm")
+        cm = m.compile(loss_type="identity", metrics=[])
+        cm.init(seed=0)
+        return cm
+
+    rng = np.random.default_rng(0)
+    av = rng.normal(size=(2, 8, 4)).astype(np.float32)
+    bv = rng.normal(size=(2, 4, 8)).astype(np.float32)
+    full = np.asarray(build(0).forward(av, bv))
+    trunc = np.asarray(build(3).forward(av, bv))
+    assert full.shape == (2, 8, 8)
+    assert trunc.shape == (2, 3, 8)
+    np.testing.assert_allclose(trunc, full[:, :3], rtol=1e-6)
