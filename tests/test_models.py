@@ -127,3 +127,44 @@ def test_resnet_search_runs():
     mach = MachineSpec(mesh_axes={"data": 4, "model": 2}, chip="v5p")
     res = search_graph(m, mach, beam_width=16)
     assert np.isfinite(res.cost) and res.cost > 0
+
+
+def test_candle_uno_builds_and_searches():
+    """CANDLE Uno (OSDI'22 AE workload, candle_uno.cc): shared-type feature
+    towers + top MLP; the search shards the fat towers."""
+    from flexflow_tpu.models import build_candle_uno
+    from flexflow_tpu.parallel.machine import MachineSpec
+    from flexflow_tpu.search.dp import search_graph
+
+    m = FFModel(FFConfig(batch_size=32))
+    ins, out = build_candle_uno(m, batch=32,
+                                dense_layers=(512,) * 2,
+                                dense_feature_layers=(512,) * 2)
+    assert out.shape == (32, 1)
+    assert len(ins) == 7
+    mach = MachineSpec(mesh_axes={"data": 2, "model": 4}, chip="v5p")
+    r = search_graph(m, mach)
+    assert r.cost > 0 and np.isfinite(r.cost)
+    # the big drug-descriptor tower goes tensor-parallel
+    assert r.choices["tower_drug1_descriptors_0"].name.startswith("tp_"), \
+        r.choices["tower_drug1_descriptors_0"].name
+
+
+def test_xdl_trains(devices):
+    """XDL (OSDI'22 AE workload, xdl.cc): embedding bank + top MLP."""
+    from flexflow_tpu.models import build_xdl
+
+    m = FFModel(FFConfig(batch_size=16, mesh_shape={"data": 2, "model": 4},
+                         search_budget=8))
+    ins, out = build_xdl(m, batch=16, embedding_size=(8192,) * 4)
+    cm = m.compile(SGDOptimizer(lr=0.01),
+                   loss_type="sparse_categorical_crossentropy", metrics=[],
+                   outputs=[out])
+    cm.init(seed=0)
+    rng = np.random.default_rng(0)
+    sparse = [rng.integers(0, 8192, size=(16, 1)).astype(np.int32)
+              for _ in range(4)]
+    dense = rng.normal(size=(16, 64)).astype(np.float32)
+    y = rng.integers(0, 2, size=(16,)).astype(np.int32)
+    h = cm.fit(sparse + [dense], y, epochs=1, verbose=False)
+    assert np.isfinite(h[0]["loss"])
