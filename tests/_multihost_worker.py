@@ -54,6 +54,19 @@ out = cm.forward(xv[:32])
 assert out.shape == (32, 4)  # global shape; values span both processes
 local = np.concatenate([np.asarray(s.data) for s in out.addressable_shards])
 assert local.shape == (16, 4) and np.isfinite(local).all()
+# distributed checkpoint: orbax coordinates the per-process shard writes;
+# both ranks must call save/restore collectively
+import tempfile
+
+ckdir = sys.argv[4] if len(sys.argv) > 4 else tempfile.gettempdir() + "/mh_ck"
+cm.save_checkpoint(ckdir)
+before = float(np.abs(np.asarray(jax.device_get(
+    cm.params["fc1"]["kernel"]))).sum())
+cm.init(seed=99)  # clobber
+cm.load_checkpoint(ckdir)
+after = float(np.abs(np.asarray(jax.device_get(
+    cm.params["fc1"]["kernel"]))).sum())
+assert abs(before - after) < 1e-5, (before, after)
 cm.set_weight("head", "kernel", np.zeros((64, 4), np.float32))
 assert float(np.abs(cm.get_weight("head", "kernel")).sum()) == 0.0
 # the global weight state must be identical across processes: fetch a

@@ -31,10 +31,11 @@ def test_two_process_distributed_fit(tmp_path):
     8-device world; fit runs control-replicated and converges identically."""
     port = _free_port()
     nproc = 2
+    ckdir = str(tmp_path / "mh_ckpt")
     procs = [
         subprocess.Popen(
             [sys.executable, "tests/_multihost_worker.py", str(port),
-             str(nproc), str(pid)],
+             str(nproc), str(pid), ckdir],
             cwd="/root/repo", stdout=subprocess.PIPE, stderr=subprocess.PIPE,
             text=True)
         for pid in range(nproc)
