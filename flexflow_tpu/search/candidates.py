@@ -208,7 +208,10 @@ def layer_candidates(layer: "Layer", machine: MachineSpec, batch_sizes,
                 sdims = [[dp_in[0][0], m, None]] * 3
                 sout = [[dp_out[0][0], m, None]]
                 kv_chunk = cm.shard_bytes(kspec, sdims[1], machine)
-                ring_comm = 2.0 * (dm - 1) * kv_chunk / machine.axis_bw(m)
+                # fwd: k+v rotate (dm-1) times; bwd (custom VJP second ring
+                # pass): k, v, dk, dv rotate dm times each
+                ring_comm = ((2.0 * (dm - 1) + 4.0 * dm) * kv_chunk
+                             / machine.axis_bw(m))
                 cands.append(Candidate(
                     f"sp_ring:{m}", sdims, sout, dict(repl_w),
                     compute_degree=max(1, dp.compute_degree) * dm,
@@ -308,21 +311,33 @@ def layer_candidates(layer: "Layer", machine: MachineSpec, batch_sizes,
         # switch-based placement stacks branch outputs: all branch shapes
         # must be equal, and stateful sub-ops (batch_norm running stats,
         # cache) cannot thread state through the shard_map body
-        from flexflow_tpu.ops.fork_join import inter_placeable
+        from flexflow_tpu.ops.fork_join import congruent_branches, inter_placeable
 
         if not inter_placeable(layer):
             return cands
+        stacked = congruent_branches(layer)
         for m in maxes:
             if machine.mesh_axes[m] != k:
                 continue
             out_bytes = cm.shard_bytes(ospecs[0], dp_out[0], machine)
             comm = (cm.all_reduce_time(out_bytes, (m,), machine) if join == "add"
                     else cm.all_gather_time(out_bytes, (m,), machine))
+            if stacked:
+                # owned-device residency: stacked (k, ...) weights sharded
+                # over the placement axis — memory, streaming AND grad
+                # all-reduce all divide by k (grad_sync sees the shard)
+                wd = {w: [m] for w in layer.weight_specs}
+                frac = 1.0
+            else:
+                # heterogeneous branches: full replication (union resident
+                # everywhere), each device STREAMS only its branch's share
+                wd = dict(repl_w)
+                frac = 1.0 / k
             cands.append(Candidate(
-                f"inter:{m}", dp_in, dp_out, dict(repl_w),
+                f"inter:{m}", dp_in, dp_out, wd,
                 compute_degree=max(1, dp.compute_degree) * k,
                 extra_comm=comm,
-                weight_stream_frac=1.0 / k))
+                weight_stream_frac=frac))
 
     elif t in UNARY_OPS or t in (OperatorType.DROPOUT, OperatorType.CAST,
                                  OperatorType.SOFTMAX, OperatorType.LOG_SOFTMAX):

@@ -7,7 +7,10 @@ cudaEvent timing, cached by (op params, machine view)
 
 TPU version: jit the op's lowering at **shard-local shapes** for the
 candidate's layout on one real chip, block_until_ready-time it, and cache by
-(params_key, layout). The known fidelity limit (SURVEY.md §7 hard part #1):
+(params_key, layout). Forward and backward are timed INDEPENDENTLY (like the
+reference's separate fwd/bwd kernel timings): backward is the jitted VJP of
+the lowering wrt (weights, float inputs), and its time is the grad-step time
+minus the forward time. The known fidelity limit (SURVEY.md §7 hard part #1):
 XLA fuses across ops, so isolated measurements over-predict; the analytic
 model is the default and this path is opt-in calibration.
 """
@@ -40,22 +43,91 @@ class MeasuredCost:
         self.machine = machine
         self.repeats = repeats
         self.warmup = warmup
-        self.cache: Dict[Tuple, float] = {}
+        self.cache: Dict[Tuple, Tuple[float, float]] = {}
+        self._floor: float = -1.0  # lazy: scalar-fetch RTT (tunnel latency)
 
-    def op_time(self, layer: "Layer", cand: "Candidate") -> float:
+    def _fetch_floor(self) -> float:
+        """The per-window cost of the synchronizing host fetch itself
+        (~75 ms through the axon tunnel, ~0 locally) — harness latency, not
+        device work; subtracted from every measured window."""
+        if self._floor >= 0.0:
+            return self._floor
+        if jax.default_backend() == "cpu":
+            # no tunnel: the fetch is ~free, and subtracting its noise can
+            # zero out sub-ms toy measurements
+            self._floor = 0.0
+            return 0.0
+        f = jax.jit(lambda i: i + 1.0)
+        self._host_sync(f(jnp.float32(0.0)))
+        ts = []
+        for i in range(3):
+            t0 = time.perf_counter()
+            self._host_sync(f(jnp.float32(float(i))))
+            ts.append(time.perf_counter() - t0)
+        self._floor = float(np.median(ts))
+        return self._floor
+
+    def op_times(self, layer: "Layer", cand: "Candidate") -> Tuple[float, float]:
+        """(fwd_seconds, bwd_seconds), measured INDEPENDENTLY — the reference
+        times forward and backward as separate kernel launches
+        (src/runtime/model.cu:38-74); ops whose bwd/fwd ratio is far from 2
+        (embedding scatter-add, attention recompute, layernorm) make the old
+        bwd≈2×fwd approximation exactly the error measurement exists to fix."""
         key = (layer.params_key(),
                tuple(tuple(map(str, d)) for d in cand.out_dims),
                tuple(sorted((w, tuple(map(str, d))) for w, d in cand.weight_dims.items())))
         if key in self.cache:
             return self.cache[key]
         try:
-            t = self._measure(layer, cand)
+            fwd, bwd = self._measure(layer, cand)
         except Exception:
-            t = cand.op_time(layer, self.machine)  # fall back to analytic
-        self.cache[key] = t
-        return t
+            # fall back to the analytic COMPUTE-ONLY time: cand.op_time
+            # includes extra_comm + grad_sync, which op_time() below adds
+            # again — subtract them or collective-heavy candidates would be
+            # double-charged exactly when measurement fails
+            from flexflow_tpu.search.candidates import _batch_axes
 
-    def _measure(self, layer: "Layer", cand: "Candidate") -> float:
+            t = cand.op_time(layer, self.machine)
+            t -= cand.extra_comm + cm.grad_sync_time(
+                layer.weight_specs, cand.weight_dims, self.machine,
+                _batch_axes(self.machine))
+            t = max(0.0, t)
+            fwd, bwd = t / 3.0, 2.0 * t / 3.0
+        self.cache[key] = (fwd, bwd)
+        return fwd, bwd
+
+    def op_time(self, layer: "Layer", cand: "Candidate") -> float:
+        fwd, bwd = self.op_times(layer, cand)
+        from flexflow_tpu.search.candidates import _batch_axes
+
+        return fwd + bwd + cand.extra_comm + cm.grad_sync_time(
+            layer.weight_specs, cand.weight_dims, self.machine,
+            _batch_axes(self.machine))
+
+    @staticmethod
+    def _host_sync(out):
+        """block_until_ready alone is NOT a reliable barrier under the axon
+        TPU tunnel (bench.py round-1 postmortem: async dispatch produced
+        physically impossible timings); fetching one element to the host
+        provably waits for the dependent chain. The device executes a single
+        stream, so waiting on the LAST call covers all queued repeats."""
+        jax.block_until_ready(out)
+        leaf = jax.tree_util.tree_leaves(out)[0]
+        np.asarray(jax.device_get(leaf)).ravel()[:1]
+
+    def _time(self, fn, *args) -> float:
+        out = fn(*args)
+        self._host_sync(out)
+        for _ in range(self.warmup):
+            self._host_sync(fn(*args))
+        floor = self._fetch_floor()
+        t0 = time.perf_counter()
+        for _ in range(self.repeats):
+            out = fn(*args)
+        self._host_sync(out)
+        return max(0.0, time.perf_counter() - t0 - floor) / self.repeats
+
+    def _measure(self, layer: "Layer", cand: "Candidate") -> Tuple[float, float]:
         machine = self.machine
         rng = np.random.default_rng(0)
         ins = []
@@ -72,23 +144,36 @@ class MeasuredCost:
             weights[w] = jnp.asarray(rng.normal(size=shp), spec.dtype.jnp_dtype)
 
         lower = get_op_def(layer.op_type).lower
+        fidx = tuple(i for i, a in enumerate(ins)
+                     if jnp.issubdtype(a.dtype, jnp.floating))
+        fins = [ins[i] for i in fidx]
+        iins = [a for i, a in enumerate(ins) if i not in fidx]
 
-        @jax.jit
-        def run(ins, weights):
+        def apply(weights, fins, iins):
+            merged, fi, ii = [], iter(fins), iter(iins)
+            for i in range(len(ins)):
+                merged.append(next(fi) if i in fidx else next(ii))
             ctx = LoweringCtx(training=False, rng=jax.random.PRNGKey(0))
-            return lower(layer, ins, weights, ctx)
+            return lower(layer, merged, weights, ctx)
 
-        out = run(ins, weights)
-        jax.block_until_ready(out)
-        for _ in range(self.warmup):
-            jax.block_until_ready(run(ins, weights))
-        t0 = time.perf_counter()
-        for _ in range(self.repeats):
-            out = run(ins, weights)
-        jax.block_until_ready(out)
-        fwd = (time.perf_counter() - t0) / self.repeats
-        # fwd+bwd ≈ 3x fwd; add the candidate's inherent collectives + grad sync
-        from flexflow_tpu.search.candidates import _batch_axes
+        run_fwd = jax.jit(apply)
+        fwd = self._time(run_fwd, weights, fins, iins)
 
-        return 3.0 * fwd + cand.extra_comm + cm.grad_sync_time(
-            layer.weight_specs, cand.weight_dims, machine, _batch_axes(machine))
+        # backward: actual VJP of the lowering wrt (weights, float inputs),
+        # timed as a separate jit; bwd = grad-step time minus forward time
+        def loss_fn(weights, fins, iins):
+            outs = apply(weights, fins, iins)
+            return sum(jnp.sum(o.astype(jnp.float32)) for o in outs
+                       if jnp.issubdtype(o.dtype, jnp.floating))
+
+        out_shapes = jax.eval_shape(apply, weights, fins, iins)
+        has_float_out = any(jnp.issubdtype(o.dtype, jnp.floating)
+                            for o in out_shapes)
+        has_diff = (bool(weights) or bool(fins)) and has_float_out
+        if has_diff:
+            run_grad = jax.jit(jax.grad(loss_fn, argnums=(0, 1)))
+            total = self._time(run_grad, weights, fins, iins)
+            bwd = max(0.0, total - fwd)
+        else:
+            bwd = 0.0
+        return fwd, bwd

@@ -57,23 +57,35 @@ def test_measured_cost_shard_shapes_and_dtype(devices):
 
 
 def test_measurement_flips_search_decision(devices):
-    """The fidelity case the measured path exists for: the analytic roofline
-    credits a row-sharded embedding with 1/8 of the table's HBM streaming,
-    but a real gather only touches the looked-up rows — measurement shows
-    the sharding buys nothing and the all-reduce penalty decides, flipping
-    the search from row:model to dp (margins ≫ CPU timing noise)."""
+    """The fidelity case the measured path exists for — and one that NEEDS
+    the independent backward timing: with a small batch against a big table,
+    the analytic roofline sees a cheap gather either way and picks dp to
+    dodge row:model's output all-reduce. But embedding BACKWARD materializes
+    a dense table-sized gradient (scatter-add into zeros); the measured VJP
+    exposes it (fwd times are near-identical, bwd differs ~10x) and flips
+    the search to row:model, whose table shard writes 1/8 of that gradient.
+    Under the old bwd≈2×fwd approximation the near-identical forwards would
+    have kept dp (margins ≫ CPU timing noise)."""
     mach = MachineSpec(mesh_axes={"data": 1, "model": 8}, chip="v5p",
-                       hbm_bw=1e10, ici_bw={"data": 5e8, "model": 5e8})
-    m = FFModel(FFConfig(batch_size=4096))
-    x = m.create_tensor([4096], dtype=DataType.INT32, name="idx")
+                       ici_bw={"data": 5e8, "model": 5e8})
+    m = FFModel(FFConfig(batch_size=512))
+    x = m.create_tensor([512], dtype=DataType.INT32, name="idx")
     m.embedding(x, 262144, 60, name="emb")  # 60 % 8 != 0: no col candidate
+    emb = m.get_layer_by_name("emb")
 
     r_analytic = search_graph(m, mach)
-    assert r_analytic.choices["emb"].name == "row:model"
+    assert r_analytic.choices["emb"].name == "dp"
 
     mc = MeasuredCost(mach, repeats=8, warmup=3)
     r_measured = search_graph(m, mach, cost_fn=mc.op_time)
-    assert r_measured.choices["emb"].name == "dp", r_measured.choices["emb"].name
+    assert r_measured.choices["emb"].name == "row:model", \
+        r_measured.choices["emb"].name
+    # the flip is a bwd-measurement effect: forwards are comparable, the
+    # dense-gradient backward is the decisive (and sharded-away) cost
+    f_dp, b_dp = mc.op_times(emb, r_analytic.choices["emb"])
+    f_row, b_row = mc.op_times(emb, r_measured.choices["emb"])
+    assert b_dp > 3.0 * b_row, (b_dp, b_row)
+    assert b_dp > 2.5 * f_dp, (f_dp, b_dp)  # bwd dwarfs the 2x-fwd guess
 
 
 def test_calibration_harness(devices, tmp_path):
@@ -93,3 +105,24 @@ def test_calibration_harness(devices, tmp_path):
     path = calibrate.write_report(rows, machine, str(tmp_path / "CAL.md"))
     text = open(path).read()
     assert "mlp" in text and "analytic/step" in text
+
+
+def test_fwd_bwd_timed_independently(devices):
+    """VERDICT r4 item 3: bwd is an actual VJP timing, not 2x fwd. op_times
+    returns (fwd, bwd) measured from separate jits; for an embedding gather
+    (bwd = scatter-add, structurally different from the gather) the pair
+    must exist independently and op_time must equal their sum + comm."""
+    m = FFModel(FFConfig(batch_size=64))
+    x = m.create_tensor([64], dtype=DataType.INT32, name="idx")
+    m.embedding(x, 5000, 64, name="emb")
+    emb = m.get_layer_by_name("emb")
+    (dp,) = [c for c in layer_candidates(emb, MACH, {64}) if c.name == "dp"]
+    mc = MeasuredCost(MACH, repeats=3, warmup=1)
+    fwd, bwd = mc.op_times(emb, dp)
+    assert fwd > 0 and bwd > 0
+    # bwd came from measurement, not the 2x-fwd approximation
+    assert abs(bwd - 2.0 * fwd) > 1e-12
+    total = mc.op_time(emb, dp)
+    assert total >= fwd + bwd  # + comm terms
+    # cached pair: repeated calls measure once
+    assert mc.op_times(emb, dp) == (fwd, bwd) and len(mc.cache) == 1
