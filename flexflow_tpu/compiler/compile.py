@@ -137,6 +137,14 @@ def compile_model(model, optimizer, loss_type: LossType, metrics: Sequence[Metri
                          loss_type, list(metrics), list(outputs))
 
 
+@partial(jax.jit, donate_argnums=(0,))
+def _stacked_slice_set(stack, value, b):
+    """Update slice b of a stacked (k, ...) weight in place, preserving its
+    sharding (used by set_weight's per-branch alias on owned fork-join
+    weights)."""
+    return jax.lax.dynamic_update_index_in_dim(stack, value, b, 0)
+
+
 class CompiledModel:
     def __init__(self, model, machine: MachineSpec, mesh: Mesh, strategy: Strategy,
                  optimizer: Optimizer, loss_type: LossType,
@@ -210,20 +218,36 @@ class CompiledModel:
             }
 
         def init_fn(key):
+            from flexflow_tpu.core.tensor import TensorSpec
+
             params = {}
             for li, layer in enumerate(layers):
                 if not layer.weight_specs:
                     continue
                 d = {}
                 for i, (wname, spec) in enumerate(sorted(layer.weight_specs.items())):
-                    # fork_join weights are "b{i}.{sublayer}.{wname}": the
-                    # default initializer keys off the terminal wname
-                    init = overrides.get((layer.name, wname)) or \
-                        default_initializer(wname.rsplit(".", 1)[-1])
+                    # fork_join weights are "b{i}.{sublayer}.{wname}" (or
+                    # "stk.{sublayer}.{wname}" stacked): the default
+                    # initializer keys off the terminal wname
                     # fold by topo position (not guid) so identically-built
                     # models init identically across FFModel instances
                     k = jax.random.fold_in(jax.random.fold_in(key, li), i)
-                    d[wname] = init(k, spec)
+                    if wname.startswith("stk."):
+                        # stacked fork_join storage: init each branch slice
+                        # independently (fan-in/out from the SLICE shape, and
+                        # per-branch initializer overrides still apply)
+                        sspec = TensorSpec(spec.shape[1:], spec.dtype)
+                        default = default_initializer(wname.rsplit(".", 1)[-1])
+                        slices = []
+                        for b in range(spec.shape[0]):
+                            init = overrides.get(
+                                (layer.name, f"b{b}.{wname[4:]}")) or default
+                            slices.append(init(jax.random.fold_in(k, b), sspec))
+                        d[wname] = jnp.stack(slices)
+                    else:
+                        init = overrides.get((layer.name, wname)) or \
+                            default_initializer(wname.rsplit(".", 1)[-1])
+                        d[wname] = init(k, spec)
                 params[layer.name] = d
             return params
 
@@ -244,6 +268,8 @@ class CompiledModel:
         # every dot runs at HIGHEST precision (f32 accumulation passes)
         precision = None if self.cfg.allow_tensor_op_math_conversion else "highest"
 
+        regularizers = dict(self.model._weight_regularizers)
+
         def train_step(params, opt_state, state, inputs, label, rng):
             def loss_fn(p):
                 fwd = forward_fn
@@ -252,6 +278,11 @@ class CompiledModel:
                 outs, new_state = fwd(p, state, inputs, True, rng)
                 logits = outs[0]
                 loss = compute_loss(loss_type, logits.astype(jnp.float32), label)
+                for (ln, wn), terms in regularizers.items():
+                    w = p[ln][wn].astype(jnp.float32)
+                    for mode, lam in terms:
+                        loss = loss + lam * (jnp.sum(jnp.abs(w)) if mode == "l1"
+                                             else jnp.sum(w * w))
                 return loss, (logits, new_state)
 
             (loss, (logits, new_state)), grads = jax.value_and_grad(
@@ -496,21 +527,63 @@ class CompiledModel:
         return ParallelTensor.build(layer.outputs[out_idx].spec, list(dims),
                                     self.machine)
 
+    @staticmethod
+    def _stacked_alias(layer, wname):
+        """Resolve a per-branch "b{i}.{sub}.{w}" name against stacked
+        storage: returns (stacked_key, branch_index) or None. Keeps the
+        per-branch weight API stable across the two residency regimes."""
+        if wname in layer.weight_specs or not wname.startswith("b"):
+            return None
+        head, _, rest = wname.partition(".")
+        if not rest or not head[1:].isdigit():
+            return None
+        stk = f"stk.{rest}"
+        return (stk, int(head[1:])) if stk in layer.weight_specs else None
+
     def weight_view(self, layer_name: str, wname: str = "kernel"):
         """ParallelTensor view of a weight under the compiled strategy."""
+        from flexflow_tpu.core.tensor import TensorSpec
         from flexflow_tpu.parallel.ptensor import ParallelTensor
 
         layer = self.model.get_layer_by_name(layer_name)
         sh = self.strategy.op_shardings.get(layer_name)
+        alias = self._stacked_alias(layer, wname)
+        if alias is not None:
+            stk, _b = alias
+            spec = layer.weight_specs[stk]
+            dims = list(sh.weights.get(stk, []) if sh else [])
+            # the branch slice drops the stacked dim (and its sharding)
+            return ParallelTensor.build(
+                TensorSpec(spec.shape[1:], spec.dtype), list(dims[1:]),
+                self.machine)
         dims = (sh.weights.get(wname, []) if sh else [])
         return ParallelTensor.build(layer.weight_specs[wname], list(dims),
                                     self.machine)
 
     def get_weight(self, layer_name: str, wname: str = "kernel") -> np.ndarray:
+        layer = self.model.get_layer_by_name(layer_name)
+        alias = self._stacked_alias(layer, wname)
+        if alias is not None:
+            stk, b = alias
+            return np.asarray(self.params[layer_name][stk])[b]
         return np.asarray(self.params[layer_name][wname])
 
     def set_weight(self, layer_name: str, wname: str, value):
         value = np.asarray(value)
+        layer = self.model.get_layer_by_name(layer_name)
+        alias = self._stacked_alias(layer, wname)
+        if alias is not None:
+            stk, b = alias
+            target = self.params[layer_name][stk]
+            assert value.shape == tuple(target.shape[1:]), \
+                (value.shape, target.shape)
+            # in-place sharded slice update: only the owning devices' shard
+            # moves (gathering the whole stack to host would defeat the
+            # owned-device residency); branch index is a traced argument so
+            # repeated set_weight calls hit the jit cache
+            self.params[layer_name][stk] = _stacked_slice_set(
+                target, jnp.asarray(value, target.dtype), jnp.int32(b))
+            return
         target = self.params[layer_name][wname]
         assert value.shape == tuple(target.shape), (value.shape, target.shape)
         self.params[layer_name][wname] = self._put(value, target.sharding)

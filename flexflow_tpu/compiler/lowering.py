@@ -87,6 +87,7 @@ def build_forward(
                 for bl in bls:
                     if bl.op_type in _norm_types:
                         ex.update(f"b{bi}.{bl.name}.{w}" for w in bl.weight_specs)
+                        ex.update(f"stk.{bl.name}.{w}" for w in bl.weight_specs)
             if ex:
                 cast_exempt[_l.name] = ex
 

@@ -49,6 +49,21 @@ def inter_placeable(layer: "Layer") -> bool:
                    for (ls, _b, _o) in layer.branches for l in ls)
 
 
+def congruent_branches(layer: "Layer") -> bool:
+    """True when every branch has the SAME sub-layer names and weight
+    shapes/dtypes, position by position — the symmetric case whose weights
+    can be stored STACKED ((k, ...) arrays sharded over the placement axis:
+    owned-device residency, parallel/interop.py). Heterogeneous branches
+    keep per-branch replicated weights."""
+    def sig(layers):
+        return tuple((l.name, tuple(sorted(
+            (w, s.shape, s.dtype) for w, s in l.weight_specs.items())))
+            for l in layers if l.weight_specs)
+
+    sigs = {sig(ls) for (ls, _b, _o) in layer.branches}
+    return len(sigs) == 1 and any(s for s in sigs)
+
+
 def _fj_infer(layer: Layer) -> List[TensorSpec]:
     if not hasattr(layer, "branches") or not layer.branches:
         raise ValueError("fork_join layer has no branches attached "
@@ -66,10 +81,19 @@ def _fj_infer(layer: Layer) -> List[TensorSpec]:
     if base.shape[0] != x.shape[0]:
         raise ValueError("fork_join branches must preserve the batch dim")
     layer.weight_specs = {}
-    for bi, (layers, _bx, _out) in enumerate(layer.branches):
-        for l in layers:
+    if congruent_branches(layer):
+        # stacked owned-device storage: one (k, ...) array per sub-weight,
+        # shardable over the placement axis (branch i = slice i)
+        k = len(layer.branches)
+        for l in layer.branches[0][0]:
             for w, spec in l.weight_specs.items():
-                layer.weight_specs[f"b{bi}.{l.name}.{w}"] = spec
+                layer.weight_specs[f"stk.{l.name}.{w}"] = TensorSpec(
+                    (k,) + tuple(spec.shape), spec.dtype)
+    else:
+        for bi, (layers, _bx, _out) in enumerate(layer.branches):
+            for l in layers:
+                for w, spec in l.weight_specs.items():
+                    layer.weight_specs[f"b{bi}.{l.name}.{w}"] = spec
     if join == "add":
         return [base]
     last = sum(s.shape[-1] for s in out_specs)
@@ -78,21 +102,36 @@ def _fj_infer(layer: Layer) -> List[TensorSpec]:
 
 def _branch_weight_dicts(layer: Layer, weights: Dict) -> List[Dict[str, Dict]]:
     """Split the flat prefixed weight dict back into per-branch
-    {sub_layer_name: {wname: array}}."""
+    {sub_layer_name: {wname: array}}. Stacked ("stk.") weights slice
+    branch i out of the (k, ...) array."""
     out: List[Dict[str, Dict]] = []
     for bi in range(len(layer.branches)):
-        prefix = f"b{bi}."
         d: Dict[str, Dict] = {}
         for k, v in weights.items():
-            if not k.startswith(prefix):
-                continue
-            # split at the FIRST dot: the remainder is the sub-layer's own
-            # weight name, which itself contains dots when the sub-layer is
-            # a nested fork_join composite ("b0.inner.b0.i1.kernel")
-            lname, wname = k[len(prefix):].split(".", 1)
-            d.setdefault(lname, {})[wname] = v
+            if k.startswith("stk."):
+                lname, wname = k[4:].split(".", 1)
+                d.setdefault(lname, {})[wname] = v[bi]
+            elif k.startswith(f"b{bi}."):
+                # split at the FIRST dot: the remainder is the sub-layer's
+                # own weight name, which itself contains dots when the
+                # sub-layer is a nested fork_join ("b0.inner.b0.i1.kernel")
+                lname, wname = k[len(f"b{bi}."):].split(".", 1)
+                d.setdefault(lname, {})[wname] = v
         out.append(d)
     return out
+
+
+def stacked_weight_trees(layer: Layer, weights: Dict):
+    """{sub_layer: {wname: (k, ...) array}} for the stacked storage case,
+    or None when this layer uses per-branch (heterogeneous) weights."""
+    stk = {k: v for k, v in weights.items() if k.startswith("stk.")}
+    if not stk:
+        return None
+    tree: Dict[str, Dict] = {}
+    for k, v in stk.items():
+        lname, wname = k[4:].split(".", 1)
+        tree.setdefault(lname, {})[wname] = v
+    return tree
 
 
 def _make_branch_fn(layer: Layer, bi: int, ctx: LoweringCtx):
@@ -121,6 +160,12 @@ def _fj_lower(layer: Layer, inputs, weights, ctx: LoweringCtx):
     placement = ctx.op_attrs.get(layer.name, {}).get("placement")
     if placement and ctx.mesh is not None and placement in ctx.mesh.shape \
             and inter_placeable(layer):
+        stacked = stacked_weight_trees(layer, weights)
+        if stacked is not None:
+            from flexflow_tpu.parallel.interop import place_branches_stacked
+
+            return [place_branches_stacked(ctx.mesh, placement, fns, x,
+                                           stacked, join)]
         from flexflow_tpu.parallel.interop import place_branches
 
         return [place_branches(ctx.mesh, placement, fns, x, wdicts, join)]

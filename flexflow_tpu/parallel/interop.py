@@ -21,9 +21,17 @@ placement needs runtime control flow over the device id, which is exactly
   - other mesh axes (data) keep sharding the batch dim as usual, so inter-op
     placement composes with data parallelism.
 
-Weights of all branches are passed replicated (every chip holds every
-branch's weights — the memory price of switch-based placement; the search's
-memory accounting charges the full union).
+Weight residency — two regimes:
+
+  - CONGRUENT branches (identical sub-layer names + weight shapes, the case
+    the search targets): weights are stored STACKED, one (k, ...) array per
+    sub-weight, sharded over the placement axis (`place_branches_stacked`).
+    Each device holds ONLY its branch's weights — memory, weight streaming
+    and gradient all-reduce all divide by k. This is the owned-device
+    residency of the reference's resource division (graph.cc:267-321).
+  - heterogeneous branches: weights are passed replicated (every chip holds
+    every branch's weights — the memory price of switch-based placement;
+    the search's memory accounting charges the full union).
 
 Autodiff: jax (≤0.9) mis-transposes a switch-on-axis_index inside shard_map
 (the backward collapses onto arm 0), so the VJP is written explicitly: the
@@ -148,6 +156,105 @@ def place_branches(
     run.defvjp(run_fwd, run_bwd)
 
     stacked = run(x, tuple(branch_weights))  # (k, batch, ..., d)
+    if join == "add":
+        return stacked.sum(axis=0)
+    return jnp.concatenate(list(stacked), axis=-1)
+
+
+def place_branches_stacked(
+    mesh: Mesh,
+    axis: str,
+    branch_fns: List[Callable],
+    x: jax.Array,
+    stacked_weights,
+    join: str,
+    batch_axes: Sequence[str] = ("data",),
+):
+    """Owned-device variant: `stacked_weights` is one pytree whose leaves are
+    (k, ...) arrays — leaf [i] is branch i's weight — sharded over the
+    placement axis, so each device group STORES only its branch's slice.
+    branch_fns[i](x_local, weights_tree) with weights_tree = the unstacked
+    local slice. Gradients for the stacked leaves stay sharded over the
+    placement axis (no cross-branch all-reduce at all); they sum only over
+    the axes the weights are replicated on (data)."""
+    k = len(branch_fns)
+    if axis not in mesh.shape:
+        raise ValueError(f"mesh has no axis {axis!r} (axes: {dict(mesh.shape)})")
+    if mesh.shape[axis] != k:
+        raise ValueError(
+            f"inter-op placement needs axis size == n_branches "
+            f"({axis}={mesh.shape[axis]} vs {k} branches)")
+    if join not in ("add", "concat"):
+        raise ValueError(f"unsupported join {join!r}")
+
+    db = [a for a in batch_axes if a in mesh.shape and a != axis
+          and x.shape[0] % mesh.shape[a] == 0]
+    bspec = tuple(db) if len(db) > 1 else (db[0] if db else None)
+    x_spec = PartitionSpec(bspec, *([None] * (x.ndim - 1)))
+    w_spec = jax.tree_util.tree_map(lambda _: PartitionSpec(axis),
+                                    stacked_weights)
+    stk_spec = PartitionSpec(axis, *x_spec)
+    other_axes = tuple(a for a in mesh.shape.keys() if a != axis)
+
+    def _local(ws_l):
+        # shard_map hands each device its (1, ...) slice of the stack
+        return jax.tree_util.tree_map(lambda a: a[0], ws_l)
+
+    def _arm(i):
+        def arm(x_l, ws_l):
+            return branch_fns[i](x_l, _local(ws_l))[None]
+        return arm
+
+    def _fwd_body(x_l, ws_l):
+        bi = jax.lax.axis_index(axis)
+        return jax.lax.switch(bi, [_arm(i) for i in range(k)], x_l, ws_l)
+
+    fwd_sm = shard_map(_fwd_body, mesh=mesh, in_specs=(x_spec, w_spec),
+                       out_specs=stk_spec)
+
+    def _bwd_arm(i):
+        def arm(x_l, ws_l, g_l):
+            _, pull = jax.vjp(lambda xv, wv: branch_fns[i](xv, wv),
+                              x_l, _local(ws_l))
+            dx, dw = pull(g_l[0])
+            # re-stack the local slice's gradient: (1, ...) per leaf
+            return dx, jax.tree_util.tree_map(lambda a: a[None], dw)
+        return arm
+
+    def _bwd_body(x_l, g_l, ws_l):
+        bi = jax.lax.axis_index(axis)
+        x_l = _pvary(x_l, (axis,))
+        if other_axes:
+            ws_l = _pvary(ws_l, other_axes)
+        dx, dws = jax.lax.switch(bi, [_bwd_arm(i) for i in range(k)],
+                                 x_l, ws_l, g_l)
+        # x replicated over the placement axis -> psum its grad over it;
+        # weights SHARDED over the placement axis -> no psum over it, only
+        # over the axes they are replicated on (data)
+        dx = jax.lax.psum(dx, axis)
+        if other_axes:
+            dws = jax.lax.psum(dws, other_axes)
+        return dx, dws
+
+    bwd_sm = shard_map(_bwd_body, mesh=mesh,
+                       in_specs=(x_spec, stk_spec, w_spec),
+                       out_specs=(x_spec, w_spec))
+
+    @jax.custom_vjp
+    def run(x_, ws_):
+        return fwd_sm(x_, ws_)
+
+    def run_fwd(x_, ws_):
+        return fwd_sm(x_, ws_), (x_, ws_)
+
+    def run_bwd(res, g):
+        x_, ws_ = res
+        dx, dws = bwd_sm(x_, g, ws_)
+        return dx, dws
+
+    run.defvjp(run_fwd, run_bwd)
+
+    stacked = run(x, stacked_weights)  # (k, batch, ..., d)
     if join == "add":
         return stacked.sum(axis=0)
     return jnp.concatenate(list(stacked), axis=-1)

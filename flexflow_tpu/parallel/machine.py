@@ -52,6 +52,13 @@ class MachineSpec:
     # algorithms lose the wrap link, halving effective bandwidth),
     # "switch" = full-bisection fabric (DCN default).
     axis_type: Dict[str, str] = dataclasses.field(default_factory=dict)
+    # compute/comm overlap (reference: the event-driven simulator's
+    # concurrent compute+transfer replay, simulator.h:785-827 — here a
+    # closed-form factor): fraction of a segment's pure-compute time that
+    # XLA's async collectives / latency-hiding scheduler can hide collective
+    # time behind. 0 = fully additive costing; calibrated on-chip by
+    # tools/calibrate.py (DMA-behind-matmul proxy, see CALIBRATION.md).
+    overlap_frac: float = 0.7
 
     def __post_init__(self):
         preset = CHIP_PRESETS.get(self.chip, CHIP_PRESETS["v5e"])
@@ -98,6 +105,7 @@ class MachineSpec:
             "mxu_flop_overhead": self.mxu_flop_overhead,
             "mxu_min_dim": self.mxu_min_dim,
             "axis_type": self.axis_type,
+            "overlap_frac": self.overlap_frac,
         }
 
     @staticmethod
@@ -114,6 +122,7 @@ class MachineSpec:
             mxu_flop_overhead=d.get("mxu_flop_overhead", 1.4),
             mxu_min_dim=d.get("mxu_min_dim", 128),
             axis_type=dict(d.get("axis_type", {})),
+            overlap_frac=d.get("overlap_frac", 0.7),
         )
 
     @staticmethod

@@ -104,6 +104,16 @@ def compute_time(flops: float, hbm_bytes: float, machine: MachineSpec,
     return max(t_flop, t_mem)
 
 
+def overlapped_step_cost(comp: float, comm: float, machine: MachineSpec) -> float:
+    """One layer's contribution under compute/comm overlap (the closed-form
+    stand-in for the reference's event-driven concurrent replay,
+    simulator.h:785-827): XLA's async collectives + latency-hiding scheduler
+    hide collective time behind up to machine.overlap_frac of the consumer's
+    pure compute; only the residual serializes. overlap_frac=0 degenerates
+    to additive costing. Calibrated by tools/calibrate.py (CALIBRATION.md)."""
+    return comp + max(0.0, comm - machine.overlap_frac * comp)
+
+
 def reshard_time(spec: TensorSpec, src: Sequence[DimSharding],
                  dst: Sequence[DimSharding], machine: MachineSpec) -> float:
     """Cost of moving a tensor from layout src to dst — the price of a

@@ -82,6 +82,9 @@ def search_graph(model, machine: MachineSpec, beam_width: int = 64,
     layers = topo_order(model.layers)
     batch_sizes = {t.shape[0] for t in model.input_tensors if t.ndim > 0}
     mem_budget = mem_budget or machine.hbm_bytes
+    from flexflow_tpu.search.candidates import _batch_axes
+
+    _batch_axes_cached = _batch_axes(machine)
 
     # liveness: tensor guid -> index of last consuming layer
     last_use: Dict[int, int] = {}
@@ -151,6 +154,7 @@ def search_graph(model, machine: MachineSpec, beam_width: int = 64,
                 else:
                     # edge costs: reshard each input from its frontier layout
                     feasible = True
+                    edge_comm = 0.0
                     for ii, tin in enumerate(layer.inputs):
                         cur = fmap.get(tin.guid)
                         if cur is None:
@@ -158,10 +162,23 @@ def search_graph(model, machine: MachineSpec, beam_width: int = 64,
                             break
                         want = _freeze_dims(cand.in_dims[ii] if ii < len(cand.in_dims)
                                             else [None] * tin.spec.ndim)
-                        c += cm.reshard_time(tin.spec, list(cur), list(want), machine)
+                        edge_comm += cm.reshard_time(tin.spec, list(cur), list(want), machine)
                     if not feasible:
                         continue
-                    c += cost_fn(layer, cand) if cost_fn else cand.op_time(layer, machine)
+                    total = cost_fn(layer, cand) if cost_fn else cand.op_time(layer, machine)
+                    # compute/comm overlap (the event-driven-simulator gap,
+                    # reference simulator.h:785-827, closed-form): XLA's
+                    # async collectives hide input-edge + op-inherent
+                    # collective time behind up to overlap_frac of the
+                    # consumer's pure compute. Purely additive costing
+                    # (overlap_frac=0) systematically over-prices strategies
+                    # whose collectives ride behind the next op's matmuls.
+                    op_comm = cand.extra_comm + cm.grad_sync_time(
+                        layer.weight_specs, cand.weight_dims, machine,
+                        _batch_axes_cached)
+                    comp = max(0.0, total - op_comm)
+                    c += cm.overlapped_step_cost(comp, edge_comm + op_comm,
+                                                 machine)
                     wm = w_mem + cand.weight_mem_bytes(layer, machine)
                     out_dims = {
                         o.guid: _freeze_dims(cand.out_dims[oi] if oi < len(cand.out_dims)

@@ -25,6 +25,7 @@ from flexflow_tpu.parallel.sharding import OpSharding, Strategy
 from flexflow_tpu.search import cost_model as cm
 from flexflow_tpu.search.candidates import (
     Candidate,
+    _batch_axes,
     _dp_dims,
     candidate_attrs,
     layer_candidates,
@@ -64,14 +65,20 @@ def assignment_cost(layers, input_tensors, assignment: Dict[str, int],
             for o in layer.outputs:
                 lay[o.guid] = od
             continue
+        edge_comm = 0.0
         for ii, tin in enumerate(layer.inputs):
             cur = lay.get(tin.guid)
             if cur is None:
                 cur = _freeze_dims([None] * tin.spec.ndim)
             want = _freeze_dims(cand.in_dims[ii] if ii < len(cand.in_dims)
                                 else [None] * tin.spec.ndim)
-            total += cm.reshard_time(tin.spec, list(cur), list(want), machine)
-        total += cand.op_time(layer, machine)
+            edge_comm += cm.reshard_time(tin.spec, list(cur), list(want), machine)
+        # same overlap-aware accumulation as the frontier DP (search/dp.py)
+        op_comm = cand.extra_comm + cm.grad_sync_time(
+            layer.weight_specs, cand.weight_dims, machine,
+            _batch_axes(machine))
+        comp = max(0.0, cand.op_time(layer, machine) - op_comm)
+        total += cm.overlapped_step_cost(comp, edge_comm + op_comm, machine)
         for oi, o in enumerate(layer.outputs):
             lay[o.guid] = _freeze_dims(
                 cand.out_dims[oi] if oi < len(cand.out_dims)

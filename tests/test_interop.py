@@ -91,11 +91,23 @@ def _fat_model(hidden=2048):
 
 
 def test_fork_join_infer_and_weights():
+    # congruent branches (same sub-layer names + shapes): STACKED owned
+    # storage — one (k, ...) spec per sub-weight, shardable over the
+    # placement axis
     m = _fat_model()
     fj = m.get_layer_by_name("fj")
     assert fj.outputs[0].spec.shape == (32, 64)
-    assert "b0.mid.kernel" in fj.weight_specs
-    assert fj.weight_specs["b1.out.kernel"].shape == (2048, 64)
+    assert fj.weight_specs["stk.mid.kernel"].shape == (2, 64, 2048)
+    assert fj.weight_specs["stk.out.kernel"].shape == (2, 2048, 64)
+
+    # heterogeneous branches keep per-branch replicated weights
+    m2 = FFModel(FFConfig(batch_size=32, mesh_shape={"data": 4, "model": 2}))
+    x = m2.create_tensor([32, 64], name="x")
+    m2.fork_join(x, [_branch_builder(512, "relu"),
+                     _branch_builder(2048, "gelu")], join="add", name="fj")
+    fj2 = m2.get_layer_by_name("fj")
+    assert "b0.mid.kernel" in fj2.weight_specs
+    assert fj2.weight_specs["b1.out.kernel"].shape == (2048, 64)
 
 
 def test_search_places_fat_branches_on_disjoint_chips():
@@ -105,9 +117,28 @@ def test_search_places_fat_branches_on_disjoint_chips():
     fat = _fat_model(hidden=4096)
     r = search_graph(fat, MACH)
     assert r.choices["fj"].name == "inter:model", r.choices["fj"].name
+    # owned-device residency: the stacked weights are sharded over the
+    # placement axis, so inter HALVES the fork-join's weight memory
+    dp_cand = [c for l in fat.layers if l.name == "fj"
+               for c in __import__("flexflow_tpu.search.candidates",
+                                   fromlist=["layer_candidates"])
+               .layer_candidates(l, MACH, {32}) if c.name == "dp"][0]
+    fj = fat.get_layer_by_name("fj")
+    assert r.choices["fj"].weight_mem_bytes(fj, MACH) * 2 == \
+        dp_cand.weight_mem_bytes(fj, MACH)
 
-    thin = _fat_model(hidden=8)
-    r2 = search_graph(thin, MACH)
+    # tiny branches with an expensive join (slow ICI, no overlap credit):
+    # the join collective dominates what placement saves — dp must win.
+    # (With owned-weight residency, inter now wins whenever grad-sync
+    # savings exceed the join cost, so the gate case is branches with
+    # nothing to save: weightless activation branches.)
+    slow = MachineSpec(mesh_axes={"data": 4, "model": 2}, chip="v5p",
+                       ici_bw={"data": 5e8, "model": 5e8}, overlap_frac=0.0)
+    thin = FFModel(FFConfig(batch_size=32, mesh_shape={"data": 4, "model": 2}))
+    x = thin.create_tensor([32, 64], name="x")
+    thin.fork_join(x, [lambda m_, t: m_.relu(t), lambda m_, t: m_.tanh(t)],
+                   join="add", name="fj")
+    r2 = search_graph(thin, slow)
     assert r2.choices["fj"].name == "dp", r2.choices["fj"].name
 
 
@@ -217,3 +248,92 @@ def test_fork_join_concat_join(devices):
     rng = np.random.default_rng(1)
     out = cm.forward(rng.normal(size=(16, 32)).astype(np.float32))
     assert np.asarray(out).shape == (16, 128)
+
+
+def test_place_branches_stacked_matches_and_grads(devices):
+    """Owned-weight placement: stacked (k, ...) weights sharded over the
+    placement axis must reproduce sequential numerics AND sequential
+    gradients (forward switch + hand-written VJP, parallel/interop.py)."""
+    from flexflow_tpu.parallel.interop import place_branches_stacked
+
+    mesh = build_mesh(MACH)  # model axis = 2
+
+    def b0(x, w):
+        return jnp.tanh(x @ w["w"])
+
+    def b1(x, w):
+        return jax.nn.relu(x @ w["w"]) * 2.0
+
+    rng = np.random.default_rng(0)
+    stk = {"w": jnp.asarray(rng.normal(size=(2, 16, 8)), jnp.float32)}
+    x = jnp.asarray(rng.normal(size=(8, 16)), jnp.float32)
+
+    def seq(x_, ws_):
+        return b0(x_, {"w": ws_["w"][0]}) + b1(x_, {"w": ws_["w"][1]})
+
+    out = place_branches_stacked(mesh, "model", [b0, b1], x, stk, "add")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(seq(x, stk)),
+                               rtol=2e-6)
+
+    gp = jax.grad(lambda w: jnp.sum(place_branches_stacked(
+        mesh, "model", [b0, b1], x, w, "add") ** 2))(stk)
+    gs = jax.grad(lambda w: jnp.sum(seq(x, w) ** 2))(stk)
+    np.testing.assert_allclose(np.asarray(gp["w"]), np.asarray(gs["w"]),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_stacked_weights_owned_per_device(devices):
+    """The round-5 residency upgrade: under inter placement the stacked
+    weights are SHARDED over the placement axis — each device group stores
+    only its branch (1, ...) slice, not the union."""
+    cfg = FFConfig(batch_size=32, mesh_shape={"data": 4, "model": 2},
+                   search_budget=8)
+    m = FFModel(cfg)
+    x = m.create_tensor([32, 64], name="x")
+    m.fork_join(x, [_branch_builder(4096, "relu"),
+                    _branch_builder(4096, "gelu")], join="add", name="fj")
+    cm = m.compile(SGDOptimizer(lr=0.01), loss_type="mean_squared_error",
+                   metrics=[])
+    assert cm.strategy.op_shardings["fj"].attrs.get("placement") == "model"
+    cm.init(seed=0)
+    arr = cm.params["fj"]["stk.mid.kernel"]
+    assert arr.shape == (2, 64, 4096)
+    assert next(iter(arr.addressable_shards)).data.shape[0] == 1, \
+        "each device must hold exactly its branch's slice"
+    # per-branch weight API still works against stacked storage
+    w0 = cm.get_weight("fj", "b0.mid.kernel")
+    assert w0.shape == (64, 4096)
+    cm.set_weight("fj", "b1.mid.kernel", np.zeros((64, 4096), np.float32))
+    assert np.all(cm.get_weight("fj", "b1.mid.kernel") == 0)
+    assert not np.all(cm.get_weight("fj", "b0.mid.kernel") == 0)
+
+
+def test_inter_memory_gate(devices):
+    """Memory-aware placement: a fork-join whose weight union (x4 for
+    grads + Adam moments) exceeds HBM under replication but fits sharded
+    must be placed inter: BY THE MEMORY GATE (compute alone is near-neutral
+    at batch 8), and the searched plan's high-water must fit the budget."""
+    mach = MachineSpec(mesh_axes={"data": 2, "model": 4}, chip="v5p",
+                       hbm_bytes=2.0e9)
+    m = FFModel(FFConfig(batch_size=8))
+    x = m.create_tensor([8, 1024], name="x")
+    # 4 branches x (1024x16384 + 16384x1024) f32 = 536 MB union; x4 persistent
+    # = 2.1 GB > 2.0 GB budget replicated; /4 sharded = 536 MB fits
+    m.fork_join(x, [_branch_builder2(16384, a)
+                    for a in ("relu", "gelu", "tanh", "sigmoid")],
+                join="add", name="fj")
+    r = search_graph(m, mach)
+    assert r.choices["fj"].name == "inter:model", r.choices["fj"].name
+    assert r.mem_bytes <= 2.0e9, r.mem_bytes
+    fj = m.get_layer_by_name("fj")
+    dp_cand = [c for c in __import__(
+        "flexflow_tpu.search.candidates", fromlist=["layer_candidates"])
+        .layer_candidates(fj, mach, {8}) if c.name == "dp"][0]
+    assert dp_cand.weight_mem_bytes(fj, mach) > 2.0e9  # replicated busts HBM
+
+
+def _branch_builder2(hidden, act):
+    def build(m, x):
+        h = m.dense(x, hidden, activation=act, use_bias=False, name="mid")
+        return m.dense(h, 1024, use_bias=False, name="out")
+    return build

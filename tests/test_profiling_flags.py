@@ -117,5 +117,7 @@ def test_search_num_nodes_workers_strategy_export(devices, tmp_path):
     # the searched machine is 2 (DCN) x 4: the exported strategy shards the
     # fat MLP weights over the 4-worker model axis
     assert st.mesh_axes == {"data": 2, "model": 4}, st.mesh_axes
-    assert st.op_shardings["up"].weights.get("kernel") == [None, "model"], \
+    # tp_col or tp_row both satisfy the intent (overlap-aware costing may
+    # prefer either: the all-gather/psum hides behind the fat matmul)
+    assert "model" in st.op_shardings["up"].weights.get("kernel", []), \
         st.op_shardings["up"].weights

@@ -116,3 +116,30 @@ def test_transformer_block_search_runs():
     assert np.isfinite(res.cost) and res.cost > 0
     st = result_to_strategy(m, V5P8, res)
     assert "mha" in st.op_shardings
+
+
+def test_overlap_aware_costing_flips_decision(devices):
+    """C12 closure (reference event-driven simulator's compute/comm overlap,
+    simulator.h:785-827): additive costing over-prices a strategy whose
+    all-gather XLA hides behind the next layer's matmuls. fc1 tp_col saves
+    weight streaming but its output all-gather precedes the wide fc2;
+    additive ranking rejects it, overlap-aware ranking (collectives hidden
+    up to overlap_frac x consumer compute) picks it — and prices the plan
+    strictly cheaper."""
+    from flexflow_tpu.parallel.machine import MachineSpec
+    from flexflow_tpu.search.dp import search_graph
+
+    def build():
+        m = FFModel(FFConfig(batch_size=8))
+        x = m.create_tensor([8, 4096], name="x")
+        h = m.dense(x, 4096, name="fc1")
+        m.dense(h, 32768, name="fc2")
+        return m
+
+    base = dict(mesh_axes={"data": 1, "model": 8}, chip="v5p",
+                ici_bw={"data": 2e9, "model": 2e9})
+    r_add = search_graph(build(), MachineSpec(**base, overlap_frac=0.0))
+    r_ovl = search_graph(build(), MachineSpec(**base, overlap_frac=0.9))
+    assert r_add.choices["fc1"].name == "dp", r_add.choices["fc1"].name
+    assert r_ovl.choices["fc1"].name == "tp_col:model", r_ovl.choices["fc1"].name
+    assert r_ovl.cost < r_add.cost

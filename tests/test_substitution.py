@@ -133,14 +133,22 @@ def test_dp_matches_bruteforce_on_chain():
     from flexflow_tpu.search.candidates import _dp_dims
     from flexflow_tpu.search.dp import _freeze_dims
 
+    from flexflow_tpu.search.candidates import _batch_axes
+
+    baxes = _batch_axes(MACH)
     best = float("inf")
     for combo in itertools.product(*cand_lists):
         cur = _freeze_dims(_dp_dims((16, 512), MACH, batch_sizes))
         cost = 0.0
         for layer, cand in zip(layers, combo):
             want = _freeze_dims(cand.in_dims[0])
-            cost += cm.reshard_time(layer.inputs[0].spec, list(cur), list(want), MACH)
-            cost += cand.op_time(layer, MACH)
+            edge = cm.reshard_time(layer.inputs[0].spec, list(cur), list(want), MACH)
+            # mirror the DP's overlap-aware accumulation (search/dp.py):
+            # collectives hide behind up to overlap_frac of consumer compute
+            op_comm = cand.extra_comm + cm.grad_sync_time(
+                layer.weight_specs, cand.weight_dims, MACH, baxes)
+            comp = max(0.0, cand.op_time(layer, MACH) - op_comm)
+            cost += comp + max(0.0, edge + op_comm - MACH.overlap_frac * comp)
             cur = _freeze_dims(cand.out_dims[0])
         best = min(best, cost)
     res = search_graph(m, MACH, beam_width=10_000)
