@@ -34,6 +34,19 @@ class FFModel:
         self.label_tensor: Optional[Tensor] = None
         self._compiled = None  # CompiledModel after compile()
         self._initializer_overrides: Dict[Tuple[str, str], Any] = {}
+        # (layer, wname) -> [("l1"|"l2", coeff)]: penalty terms the compiled
+        # train step adds to the loss (keras kernel_regularizer analog —
+        # reference RegularizerMode, python/flexflow/keras/regularizers.py)
+        self._weight_regularizers: Dict[Tuple[str, str], List[Tuple[str, float]]] = {}
+
+    def add_weight_regularizer(self, layer_name: str, wname: str,
+                               mode: str, coeff: float) -> None:
+        """Register an L1/L2 penalty on a weight; differentiated as part of
+        the training loss (compiler/compile.py)."""
+        if mode not in ("l1", "l2"):
+            raise ValueError(f"unknown regularizer mode {mode!r}")
+        self._weight_regularizers.setdefault(
+            (layer_name, wname), []).append((mode, float(coeff)))
 
     # ---------------------------------------------------------------- builder
     def create_tensor(self, dims: Sequence[int], dtype=DataType.FLOAT,

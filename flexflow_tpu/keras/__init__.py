@@ -7,6 +7,15 @@ Usage mirrors the reference examples (examples/python/keras/):
     import flexflow_tpu.keras.optimizers
 """
 
-from flexflow_tpu.keras import callbacks, datasets, layers, models, optimizers  # noqa: F401
+from flexflow_tpu.keras import (  # noqa: F401
+    callbacks,
+    datasets,
+    initializers,
+    layers,
+    models,
+    optimizers,
+    preprocessing,
+    regularizers,
+)
 from flexflow_tpu.losses import LossType as losses  # noqa: F401
 from flexflow_tpu.metrics import MetricsType as metrics  # noqa: F401

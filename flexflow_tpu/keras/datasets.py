@@ -61,3 +61,60 @@ class cifar10(_ImageDataset):
 class mnist(_ImageDataset):
     shape = (28, 28)
     fname = "mnist.npz"
+
+
+class reuters:
+    """Reuters newswire topics (reference python/flexflow/keras/datasets/
+    reuters.py + the seq_reuters_mlp example). No egress: looks for a local
+    reuters.npz; otherwise generates a deterministic synthetic corpus with
+    LEARNABLE topics — each class draws its words from a class-specific
+    Zipf-ish distribution, so the reuters MLP pipeline genuinely learns."""
+
+    classes = 46
+
+    @classmethod
+    def load_data(cls, path: str = "reuters.npz", num_words=None,
+                  skip_top: int = 0, maxlen=None, test_split: float = 0.2,
+                  seed: int = 113, num_samples: int = 2000):
+        for base in (os.environ.get("KERAS_DATA_DIR", ""),
+                     os.path.expanduser("~/.keras/datasets")):
+            p = os.path.join(base, path) if base else ""
+            if p and os.path.exists(p):
+                d = np.load(p, allow_pickle=True)
+                xs, ys = list(d["x"]), d["y"].astype(np.int64)
+                break
+        else:
+            warnings.warn("reuters.npz not found locally; using synthetic "
+                          "corpus (no network egress)")
+            rng = np.random.default_rng(seed)
+            vocab = num_words or 1000
+            # class-specific word banks: topic c prefers a 30-word cluster
+            banks = np.random.default_rng(99).integers(
+                4, vocab, size=(cls.classes, 30))
+            xs, ys = [], []
+            for i in range(num_samples):
+                c = int(rng.integers(0, cls.classes))
+                length = int(rng.integers(20, 120))
+                topical = rng.choice(banks[c], size=length // 2)
+                background = rng.integers(4, vocab, size=length - length // 2)
+                words = np.concatenate([topical, background])
+                rng.shuffle(words)
+                xs.append([1] + words.tolist())  # 1 = start marker
+                ys.append(c)
+            ys = np.asarray(ys, np.int64)
+        if num_words:
+            xs = [[w for w in s if skip_top <= w < num_words] for s in xs]
+        if maxlen:
+            from flexflow_tpu.keras.preprocessing.sequence import _remove_long_seq
+
+            xs, ys = _remove_long_seq(maxlen, xs, ys)
+            ys = np.asarray(ys, np.int64)
+        # keras split semantics: train = leading (1 - test_split) fraction,
+        # test = the tail
+        n_train = len(xs) - int(len(xs) * test_split)
+        return ((xs[:n_train], ys[:n_train]), (xs[n_train:], ys[n_train:]))
+
+    @staticmethod
+    def get_word_index(path: str = "reuters_word_index.json"):
+        # synthetic corpus has no real words; expose a stable id mapping
+        return {f"w{i}": i for i in range(4, 1000)}

@@ -76,25 +76,46 @@ def _conv_padding(padding, kernel):
     raise ValueError(f"padding {padding!r}")
 
 
+def _apply_regularizers(ff, out_tensor, kernel_reg, bias_reg):
+    """Register this layer's L1/L2 penalties on the built FFModel (they
+    become differentiated loss terms, see keras/regularizers.py)."""
+    from flexflow_tpu.keras import regularizers as kreg
+
+    lname = out_tensor.owner.name
+    for wname, reg in (("kernel", kernel_reg), ("bias", bias_reg)):
+        reg = kreg.get(reg)
+        if reg is None:
+            continue
+        for mode, coeff in reg.terms():
+            ff.add_weight_regularizer(lname, wname, mode, coeff)
+
+
 class Dense(Layer):
     def __init__(self, units, activation=None, use_bias=True,
-                 kernel_initializer=None, bias_initializer=None, **kw):
+                 kernel_initializer=None, bias_initializer=None,
+                 kernel_regularizer=None, bias_regularizer=None, **kw):
         super().__init__(**kw)
         self.units = int(units)
         self.activation = activation
         self.use_bias = use_bias
         self.kernel_initializer = kernel_initializer
         self.bias_initializer = bias_initializer
+        self.kernel_regularizer = kernel_regularizer
+        self.bias_regularizer = bias_regularizer
 
     def to_ff(self, ff, ins):
-        return [ff.dense(ins[0], self.units, activation=self.activation,
-                         use_bias=self.use_bias, name=self.name)]
+        out = ff.dense(ins[0], self.units, activation=self.activation,
+                       use_bias=self.use_bias, name=self.name)
+        _apply_regularizers(ff, out, self.kernel_regularizer,
+                            self.bias_regularizer if self.use_bias else None)
+        return [out]
 
 
 class Conv2D(Layer):
     def __init__(self, filters, kernel_size, strides=(1, 1), padding="valid",
                  activation=None, groups=1, use_bias=True,
-                 kernel_initializer=None, bias_initializer=None, **kw):
+                 kernel_initializer=None, bias_initializer=None,
+                 kernel_regularizer=None, bias_regularizer=None, **kw):
         super().__init__(**kw)
         self.filters = int(filters)
         self.kernel = _pair(kernel_size)
@@ -103,14 +124,19 @@ class Conv2D(Layer):
         self.activation = activation
         self.groups = groups
         self.use_bias = use_bias
+        self.kernel_regularizer = kernel_regularizer
+        self.bias_regularizer = bias_regularizer
 
     def to_ff(self, ff, ins):
         kh, kw = self.kernel
         sh, sw = self.strides
         ph, pw = self.padding
-        return [ff.conv2d(ins[0], self.filters, kh, kw, sh, sw, ph, pw,
-                          activation=self.activation, groups=self.groups,
-                          use_bias=self.use_bias, name=self.name)]
+        out = ff.conv2d(ins[0], self.filters, kh, kw, sh, sw, ph, pw,
+                        activation=self.activation, groups=self.groups,
+                        use_bias=self.use_bias, name=self.name)
+        _apply_regularizers(ff, out, self.kernel_regularizer,
+                            self.bias_regularizer if self.use_bias else None)
+        return [out]
 
 
 class _Pool2D(Layer):

@@ -165,7 +165,12 @@ class Sequential(BaseModel):
         for l in layers or []:
             self.add(l)
 
-    def add(self, layer: Layer):
+    def add(self, layer):
+        # model.add(Input(shape=...)) — the reference's sequential examples
+        # (e.g. seq_reuters_mlp.py) add the input tensor itself
+        if isinstance(layer, KTensor):
+            self._input_shape = layer.shape
+            return
         self._layers.append(layer)
 
     def _graph_inputs(self):
@@ -182,10 +187,11 @@ class Sequential(BaseModel):
         from flexflow_tpu.keras.layers import Input
 
         first = self._layers[0]
-        shape = getattr(first, "_declared_input_shape", None)
+        shape = self._input_shape or getattr(first, "_declared_input_shape", None)
         if shape is None:
             raise ValueError(
-                "Sequential needs the first layer built with input_shape=...")
+                "Sequential needs an added Input(...) or a first layer "
+                "built with input_shape=...")
         t = Input(shape)
         self.__inputs = [t]
         for l in self._layers:

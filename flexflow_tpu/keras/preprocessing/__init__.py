@@ -1,0 +1,6 @@
+"""Keras preprocessing (reference python/flexflow/keras/preprocessing/)."""
+
+from flexflow_tpu.keras.preprocessing import sequence, text
+from flexflow_tpu.keras.preprocessing.sequence import pad_sequences
+
+__all__ = ["sequence", "text", "pad_sequences"]

@@ -45,6 +45,16 @@ def flash_supported(seq: int, depth: int, itemsize: int = 4) -> bool:
 
 
 def _pick_block(s: int) -> int:
+    import os
+
+    try:
+        forced = int(os.environ.get("FLEXFLOW_FLASH_BLOCK", "0"))
+    except ValueError:
+        forced = 0
+    # tuning override: only known-safe block sizes (VMEM budget was sized
+    # for _BLOCK_CANDIDATES; arbitrary values could OOM Mosaic)
+    if forced in _BLOCK_CANDIDATES and s % forced == 0:
+        return forced
     for b in _BLOCK_CANDIDATES:
         if s % b == 0:
             return b

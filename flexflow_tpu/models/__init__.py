@@ -15,10 +15,11 @@ from flexflow_tpu.models.moe import build_moe_mlp
 from flexflow_tpu.models.inception import build_inception_v3
 from flexflow_tpu.models.candle_uno import build_candle_uno
 from flexflow_tpu.models.xdl import build_xdl
+from flexflow_tpu.models.resnext import build_resnext50, resnext_block
 
 __all__ = [
     "build_mlp", "build_alexnet", "build_resnet50", "build_resnet_block",
-    "build_candle_uno", "build_xdl",
+    "build_candle_uno", "build_xdl", "build_resnext50", "resnext_block",
     "build_dlrm", "build_transformer", "build_gpt2", "GPT2Config",
     "build_bert", "build_moe_mlp", "build_inception_v3",
 ]
