@@ -1,0 +1,23 @@
+# Sphinx configuration for flexflow_tpu (reference analog:
+# /root/reference/docs/source/conf.py). Build: sphinx-build -b html . _build
+# (sphinx is not vendored in the dev image; the tree is plain rst + autodoc
+# directives and renders with any stock sphinx >= 4).
+
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath("../.."))
+
+project = "flexflow_tpu"
+author = "flexflow_tpu developers"
+release = "0.5"
+
+extensions = [
+    "sphinx.ext.autodoc",
+    "sphinx.ext.napoleon",
+    "sphinx.ext.viewcode",
+]
+
+autodoc_mock_imports = ["jax", "jaxlib", "optax", "orbax", "numpy", "torch"]
+exclude_patterns = ["_build"]
+html_theme = "alabaster"
