@@ -56,8 +56,12 @@ class MachineSpec:
     # concurrent compute+transfer replay, simulator.h:785-827 — here a
     # closed-form factor): fraction of a segment's pure-compute time that
     # XLA's async collectives / latency-hiding scheduler can hide collective
-    # time behind. 0 = fully additive costing; calibrated on-chip by
-    # tools/calibrate.py (DMA-behind-matmul proxy, see CALIBRATION.md).
+    # time behind. 0 = fully additive costing. The on-chip DMA-behind-matmul
+    # proxy measures a ceiling of 1.00 (CALIBRATION.md: an independent
+    # 256 MB reduction hides completely behind a matmul chain); the default
+    # stays below it because real collectives sit on dataflow edges (their
+    # producer must finish first), so only part of the consumer's compute
+    # window is usable in the worst case.
     overlap_frac: float = 0.7
 
     def __post_init__(self):

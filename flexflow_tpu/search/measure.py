@@ -110,10 +110,14 @@ class MeasuredCost:
         TPU tunnel (bench.py round-1 postmortem: async dispatch produced
         physically impossible timings); fetching one element to the host
         provably waits for the dependent chain. The device executes a single
-        stream, so waiting on the LAST call covers all queued repeats."""
+        stream, so waiting on the LAST call covers all queued repeats.
+        Fetch ONE SCALAR, never the full array — device_get of a production
+        weight gradient (~200 MB) costs seconds through the tunnel."""
         jax.block_until_ready(out)
         leaf = jax.tree_util.tree_leaves(out)[0]
-        np.asarray(jax.device_get(leaf)).ravel()[:1]
+        scalar = leaf if getattr(leaf, "ndim", 0) == 0 \
+            else leaf[(0,) * leaf.ndim]
+        np.asarray(jax.device_get(scalar))
 
     def _time(self, fn, *args) -> float:
         out = fn(*args)
