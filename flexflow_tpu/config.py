@@ -45,6 +45,13 @@ class FFConfig:
     export_strategy_file: str = ""
     memory_search: bool = False
     substitution_json: str = ""
+    # event-driven task-graph re-rank of the DP finalists (reference
+    # LogicalTaskgraphBasedSimulator, simulator.h:785-827): "additive"
+    # trusts the frontier DP's closed-form costing; "taskgraph" replays the
+    # top finalists on per-stream timelines and picks by makespan
+    simulator_mode: str = "additive"
+    simulator_segment_size: int = 16 * 1024 * 1024  # model.cc:3493
+    simulator_topk: int = 4
     # machine model (cost model) description file; "" = default v5p-like model
     machine_model_file: str = ""
     # execution
@@ -98,6 +105,11 @@ class FFConfig:
         p.add_argument("--export", dest="export_file", type=str, default="")
         p.add_argument("--memory-search", action="store_true")
         p.add_argument("--substitution-json", type=str, default="")
+        p.add_argument("--simulator-mode", type=str, default="additive",
+                       choices=("additive", "taskgraph"))
+        p.add_argument("--simulator-segment-size", type=int,
+                       default=16 * 1024 * 1024)
+        p.add_argument("--simulator-topk", type=int, default=4)
         p.add_argument("--machine-model-file", type=str, default="")
         p.add_argument("--fusion", dest="fusion", action="store_true", default=True)
         p.add_argument("--no-fusion", dest="fusion", action="store_false")
@@ -137,6 +149,9 @@ class FFConfig:
             export_strategy_file=args.export_file,
             memory_search=args.memory_search,
             substitution_json=args.substitution_json,
+            simulator_mode=args.simulator_mode,
+            simulator_segment_size=args.simulator_segment_size,
+            simulator_topk=args.simulator_topk,
             machine_model_file=args.machine_model_file,
             enable_fusion=args.fusion,
             profiling=args.profiling,

@@ -71,14 +71,18 @@ def search_graph(model, machine: MachineSpec, beam_width: int = 64,
                  enable_parameter: bool = True, enable_attribute: bool = True,
                  mem_budget: Optional[float] = None,
                  cost_fn=None,
-                 pins: Optional[Dict[str, str]] = None) -> SearchResult:
+                 pins: Optional[Dict[str, str]] = None,
+                 topk: int = 1) -> "SearchResult | List[SearchResult]":
     """cost_fn(layer, cand) -> seconds overrides the analytic op time
     (hook for the measured path, search/measure.py).
 
     `model` is anything with .layers / .input_tensors (FFModel or a PCG).
     `pins` restricts named layers to one candidate (by candidate name) — the
     substitution engine's hook: a rewritten PCG is costed with its rewrite
-    choices pinned while the DP still lays out every unpinned op."""
+    choices pinned while the DP still lays out every unpinned op.
+
+    `topk > 1` returns the best `topk` finalists (List[SearchResult], one per
+    distinct terminal frontier) for the event-driven simulator re-rank."""
     layers = topo_order(model.layers)
     batch_sizes = {t.shape[0] for t in model.input_tensors if t.ndim > 0}
     mem_budget = mem_budget or machine.hbm_bytes
@@ -209,9 +213,18 @@ def search_graph(model, machine: MachineSpec, beam_width: int = 64,
         if not beam:
             raise RuntimeError(f"search dead-ended at layer {layer.name}")
 
-    best_frontier, (best_cost, best_wm, best_ah, best_trace) = min(
-        beam.items(), key=lambda kv: _score(kv[1][0], kv[1][1] + kv[1][2], mem_budget))
-    best_mem = best_wm + best_ah
-    choices = {layer.name: cand_cache[layer.name][ci]
-               for layer, ci in zip(layers, best_trace)}
-    return SearchResult(choices=choices, cost=best_cost, mem_bytes=best_mem)
+    def _to_result(entry) -> SearchResult:
+        cost, wm, ah, trace = entry
+        return SearchResult(
+            choices={layer.name: cand_cache[layer.name][ci]
+                     for layer, ci in zip(layers, trace)},
+            cost=cost, mem_bytes=wm + ah)
+
+    ranked = sorted(beam.values(),
+                    key=lambda v: _score(v[0], v[1] + v[2], mem_budget))
+    if topk > 1:
+        # distinct finalists for the event-driven re-rank (search/simulator
+        # .py): the final beam holds the best trace per terminal frontier
+        # layout — different layouts are materially different strategies
+        return [_to_result(e) for e in ranked[:topk]]
+    return _to_result(ranked[0])

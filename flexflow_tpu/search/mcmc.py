@@ -89,10 +89,17 @@ def assignment_cost(layers, input_tensors, assignment: Dict[str, int],
 def mcmc_optimize(model, machine: MachineSpec, budget: int = 500,
                   alpha: float = 0.05, seed: int = 0,
                   enable_parameter: bool = True,
-                  enable_attribute: bool = True) -> Tuple[Strategy, MCMCStats]:
+                  enable_attribute: bool = True,
+                  evaluator: str = "additive") -> Tuple[Strategy, MCMCStats]:
     """Simulated annealing over per-op candidates (reference
     model.cc:3286-3357: start from the current config, propose single-op
-    rewrites, accept with the Metropolis rule)."""
+    rewrites, accept with the Metropolis rule).
+
+    evaluator="taskgraph" scores each full assignment with the event-driven
+    simulator (search/simulator.py) instead of the additive accumulation —
+    the reference's MCMC always evaluated through its task-graph simulator
+    (simulator.cc simulate_runtime); MCMC evaluates complete assignments, so
+    the replay drops in exactly."""
     rng = random.Random(seed)
     layers = topo_order(model.layers)
     batch_sizes = {t.shape[0] for t in model.input_tensors if t.ndim > 0}
@@ -102,8 +109,19 @@ def mcmc_optimize(model, machine: MachineSpec, budget: int = 500,
     mutable = [l.name for l in layers if len(cand_lists[l.name]) > 1]
     assignment = {l.name: 0 for l in layers}  # start data-parallel (reference
     # starts from the current == default config)
-    cur = assignment_cost(layers, model.input_tensors, assignment,
-                          cand_lists, machine)
+
+    if evaluator == "taskgraph":
+        from flexflow_tpu.search.simulator import simulate_strategy
+
+        def _eval(assign):
+            choices = {n: cand_lists[n][i] for n, i in assign.items()}
+            return simulate_strategy(model, choices, machine).makespan
+    else:
+        def _eval(assign):
+            return assignment_cost(layers, model.input_tensors, assign,
+                                   cand_lists, machine)
+
+    cur = _eval(assignment)
     best, best_assign = cur, dict(assignment)
     stats = MCMCStats(init_cost=cur, best_cost=cur)
     for _step in range(budget if mutable else 0):
@@ -112,8 +130,7 @@ def mcmc_optimize(model, machine: MachineSpec, budget: int = 500,
         old = assignment[name]
         choices = [i for i in range(len(cand_lists[name])) if i != old]
         assignment[name] = rng.choice(choices)
-        nxt = assignment_cost(layers, model.input_tensors, assignment,
-                              cand_lists, machine)
+        nxt = _eval(assignment)
         delta = nxt - cur
         if delta <= 0 or rng.random() < math.exp(-alpha * delta / max(best, 1e-12)):
             cur = nxt

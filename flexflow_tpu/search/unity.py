@@ -326,6 +326,28 @@ def unity_optimize(model, machine: MachineSpec, cost_fn=None) -> Tuple[Strategy,
                             enable_parameter=en_param,
                             enable_attribute=en_attr, pins=g.pins)
 
+    def _sim_refine(g: PCG, r: SearchResult) -> SearchResult:
+        """simulator_mode='taskgraph': the additive DP prunes, the
+        event-driven replay (search/simulator.py — the reference
+        LogicalTaskgraphBasedSimulator analog) decides among the segment
+        winner's top layout finalists by simulated makespan."""
+        if cfg.simulator_mode != "taskgraph" or cfg.simulator_topk < 2:
+            return r
+        # one extra DP per SEGMENT (not per costed candidate graph) to
+        # recover the ranked finalists — ~1/budget overhead, cheaper than
+        # carrying topk lists for every graph the best-first loop prices
+        from flexflow_tpu.search import simulator as sim
+
+        finalists = search_graph(g, machine, beam_width=beam_width,
+                                 mem_budget=mem_budget, cost_fn=cost_fn,
+                                 enable_parameter=en_param,
+                                 enable_attribute=en_attr, pins=g.pins,
+                                 topk=cfg.simulator_topk)
+        picked, _reports = sim.rerank(
+            g, machine, finalists, cost_fn=cost_fn,
+            segment_bytes=cfg.simulator_segment_size)
+        return picked
+
     for si, (seg, k) in enumerate(zip(segments, keys)):
         best = best_r = None
         if k in memo:
@@ -356,6 +378,12 @@ def unity_optimize(model, machine: MachineSpec, cost_fn=None) -> Tuple[Strategy,
             stats_all.pruned += stats.pruned
             stats_all.baseline_cost += stats.baseline_cost
             stats_all.best_cost += stats.best_cost
+        refined = _sim_refine(best, best_r)
+        if refined is not best_r:
+            # keep the reported totals describing the RETURNED strategy:
+            # the re-rank may pick a finalist whose additive cost differs
+            stats_all.best_cost += refined.cost - best_r.cost
+            best_r = refined
         strategy_from_pcg(best, machine, best_r, model_layer_names,
                           model_input_names, strategy=st)
     st.name = (f"unity(cost={stats_all.best_cost * 1e3:.3f}ms, "
