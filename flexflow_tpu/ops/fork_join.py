@@ -49,6 +49,29 @@ def inter_placeable(layer: "Layer") -> bool:
                    for (ls, _b, _o) in layer.branches for l in ls)
 
 
+def grouped_placeable(layer: "Layer") -> bool:
+    """True when this fork_join can execute under UNEQUAL group placement
+    (`inter:{axis}:{g0}-{g1}-...`, parallel/interop.place_branches_grouped).
+    Branch output shapes need not match (each arm emits a zero-padded buffer
+    of the full joined output) — only stateful sub-ops are excluded."""
+    return not any(l.op_type in _STATEFUL_OPS
+                   for (ls, _b, _o) in layer.branches for l in ls)
+
+
+def branch_flops(layer: "Layer") -> List[float]:
+    """Per-branch flop counts — the load-balance weights the resource
+    division (search/candidates._best_groups; interop.divide_workers for
+    manual placement) optimizes over (reference graph.cc:267-321 enumerates
+    exactly these divisions)."""
+    return [sum(get_op_def(l.op_type).flop_count(l) for l in ls)
+            for (ls, _b, _o) in layer.branches]
+
+
+def branch_weight_bytes(layer: "Layer") -> List[int]:
+    return [sum(s.size_bytes for l in ls for s in l.weight_specs.values())
+            for (ls, _b, _o) in layer.branches]
+
+
 def congruent_branches(layer: "Layer") -> bool:
     """True when every branch has the SAME sub-layer names and weight
     shapes/dtypes, position by position — the symmetric case whose weights
@@ -158,17 +181,29 @@ def _fj_lower(layer: Layer, inputs, weights, ctx: LoweringCtx):
     fns = [_make_branch_fn(layer, bi, ctx) for bi in range(len(layer.branches))]
 
     placement = ctx.op_attrs.get(layer.name, {}).get("placement")
-    if placement and ctx.mesh is not None and placement in ctx.mesh.shape \
-            and inter_placeable(layer):
-        stacked = stacked_weight_trees(layer, weights)
-        if stacked is not None:
-            from flexflow_tpu.parallel.interop import place_branches_stacked
+    groups = ctx.op_attrs.get(layer.name, {}).get("placement_groups")
+    if placement and ctx.mesh is not None and placement in ctx.mesh.shape:
+        if groups and grouped_placeable(layer):
+            # unequal resource division: branch b owns group_sizes[b]
+            # indices of the axis and batch-shards within its group
+            from flexflow_tpu.parallel.interop import place_branches_grouped
 
-            return [place_branches_stacked(ctx.mesh, placement, fns, x,
-                                           stacked, join)]
-        from flexflow_tpu.parallel.interop import place_branches
+            gs = tuple(int(s) for s in groups.split("-"))
+            out_dims = [out.spec.shape[-1]
+                        for (_ls, _bx, out) in layer.branches]
+            return [place_branches_grouped(
+                ctx.mesh, placement, fns, x, wdicts, join, gs, out_dims,
+                layer.outputs[0].spec.ndim)]
+        if not groups and inter_placeable(layer):
+            stacked = stacked_weight_trees(layer, weights)
+            if stacked is not None:
+                from flexflow_tpu.parallel.interop import place_branches_stacked
 
-        return [place_branches(ctx.mesh, placement, fns, x, wdicts, join)]
+                return [place_branches_stacked(ctx.mesh, placement, fns, x,
+                                               stacked, join)]
+            from flexflow_tpu.parallel.interop import place_branches
+
+            return [place_branches(ctx.mesh, placement, fns, x, wdicts, join)]
     # replicated execution: every device runs every branch (batch-sharded)
     ys = [fn(x, wd) for fn, wd in zip(fns, wdicts)]
     if join == "add":

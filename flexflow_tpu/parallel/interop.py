@@ -161,6 +161,176 @@ def place_branches(
     return jnp.concatenate(list(stacked), axis=-1)
 
 
+def divide_workers(costs: Sequence[float], n: int) -> List[int]:
+    """Optimal division of n workers among branches for the makespan metric
+    max_b(costs[b] / g[b]) — the reference enumerates these divisions
+    (graph.cc:267-321, "first i of n workers vs the rest"); for the max
+    metric the greedy waterfill is exact: give every branch one worker, then
+    repeatedly give the next worker to the branch with the largest per-worker
+    cost.
+
+    Manual-placement helper for `place_branches_grouped` callers. The SEARCH
+    uses the divisor-constrained variant instead
+    (search/candidates._best_groups): the kernel row-slices the per-device
+    batch, so each g_b must divide it — a constraint under which plain
+    waterfill can emit invalid divisions."""
+    k = len(costs)
+    if n < k:
+        raise ValueError(f"need at least one worker per branch ({n} < {k})")
+    g = [1] * k
+    for _ in range(n - k):
+        b = max(range(k), key=lambda i: costs[i] / g[i])
+        g[b] += 1
+    return g
+
+
+def place_branches_grouped(
+    mesh: Mesh,
+    axis: str,
+    branch_fns: List[Callable],
+    x: jax.Array,
+    branch_weights: Sequence,
+    join: str,
+    group_sizes: Sequence[int],
+    out_dims: Sequence[int],
+    out_ndim: int,
+    batch_axes: Sequence[str] = ("data",),
+):
+    """UNEQUAL resource division: branch b owns a contiguous group of
+    `group_sizes[b]` indices of the placement axis (sum == axis size), the
+    reference's machine-resource enumeration between branches
+    (graph.cc:267-321) rather than one-index-per-branch. Devices inside a
+    group split their branch's BATCH g_b ways, so a fat branch with more
+    chips runs proportionally faster.
+
+    Mechanism: each device computes only its (branch, batch-slice) share,
+    writes it into a zero-padded buffer of the full JOINED output (feature
+    offset static per branch, batch offset dynamic in the group index), and
+    one psum over the placement axis assembles batch slices AND performs the
+    join in the same collective ("add" sums overlapping feature blocks;
+    "concat" blocks are disjoint). Weights are passed replicated (the
+    stacked owned-device storage needs one axis index per branch; unequal
+    groups trade that memory saving for balance — priced by the search).
+
+    `out_dims[b]` = branch b's last-dim width (join=="add": all equal)."""
+    k = len(branch_fns)
+    n = sum(group_sizes)
+    if axis not in mesh.shape:
+        raise ValueError(f"mesh has no axis {axis!r} (axes: {dict(mesh.shape)})")
+    if mesh.shape[axis] != n:
+        raise ValueError(f"group sizes {list(group_sizes)} sum to {n} but "
+                         f"axis {axis} has size {mesh.shape[axis]}")
+    if join not in ("add", "concat"):
+        raise ValueError(f"unsupported join {join!r}")
+    starts = [sum(group_sizes[:b]) for b in range(k)]
+    d_join = out_dims[0] if join == "add" else sum(out_dims)
+    feat_off = [0] * k if join == "add" else \
+        [sum(out_dims[:b]) for b in range(k)]
+
+    db = [a for a in batch_axes if a in mesh.shape and a != axis
+          and x.shape[0] % mesh.shape[a] == 0]
+    bspec = tuple(db) if len(db) > 1 else (db[0] if db else None)
+    b_local = x.shape[0]
+    for a in db:
+        b_local //= mesh.shape[a]
+    for g in group_sizes:
+        if b_local % g:
+            raise ValueError(
+                f"per-device batch {b_local} not divisible by group size {g} "
+                f"(groups {list(group_sizes)})")
+    x_spec = PartitionSpec(bspec, *([None] * (x.ndim - 1)))
+    o_spec = PartitionSpec(bspec, *([None] * (out_ndim - 1)))
+    w_specs = jax.tree_util.tree_map(lambda _: PartitionSpec(),
+                                     tuple(branch_weights))
+    all_axes = tuple(mesh.shape.keys())
+
+    def _row0(ndim, row):
+        return (row,) + (0,) * (ndim - 1)
+
+    def _fwd_arm(b):
+        def arm(x_l, ws_l, row):
+            m = x_l.shape[0] // group_sizes[b]
+            xs = jax.lax.dynamic_slice_in_dim(x_l, row * m, m, axis=0)
+            y = branch_fns[b](xs, ws_l[b])
+            pad = jnp.zeros(y.shape[:-1] + (d_join,), y.dtype)
+            pad = jax.lax.dynamic_update_slice(
+                pad, y, (0,) * (y.ndim - 1) + (feat_off[b],))
+            buf = jnp.zeros((x_l.shape[0],) + pad.shape[1:], y.dtype)
+            return jax.lax.dynamic_update_slice(
+                buf, pad, _row0(buf.ndim, row * m))
+        return arm
+
+    def _branch_of(bi):
+        # static decision tree over the traced axis index
+        b = jnp.zeros((), jnp.int32)
+        for j in range(1, k):
+            b = jnp.where(bi >= starts[j], j, b)
+        row = bi - jnp.take(jnp.asarray(starts), b)
+        return b, row
+
+    def _fwd_body(x_l, *ws_l):
+        b, row = _branch_of(jax.lax.axis_index(axis))
+        part = jax.lax.switch(b, [_fwd_arm(i) for i in range(k)],
+                              x_l, ws_l, row)
+        return jax.lax.psum(part, axis)
+
+    fwd_sm = shard_map(_fwd_body, mesh=mesh,
+                       in_specs=(x_spec,) + w_specs, out_specs=o_spec)
+
+    def _bwd_arm(b):
+        def arm(x_l, ws_l, g_l, row):
+            g = group_sizes[b]
+            m = x_l.shape[0] // g
+            xs = jax.lax.dynamic_slice_in_dim(x_l, row * m, m, axis=0)
+            gs = jax.lax.dynamic_slice_in_dim(g_l, row * m, m, axis=0)
+            gb = jax.lax.dynamic_slice(
+                gs, (0,) * (gs.ndim - 1) + (feat_off[b],),
+                gs.shape[:-1] + (out_dims[b],))
+            _, pull = jax.vjp(lambda xv, wv: branch_fns[b](xv, wv),
+                              xs, ws_l[b])
+            dxs, dw_b = pull(gb)
+            dx = jnp.zeros(x_l.shape, dxs.dtype)
+            dx = jax.lax.dynamic_update_slice(dx, dxs, _row0(dx.ndim, row * m))
+            dws = tuple(dw_b if j == b
+                        else jax.tree_util.tree_map(jnp.zeros_like, ws_l[j])
+                        for j in range(k))
+            return dx, dws
+        return arm
+
+    def _bwd_body(x_l, g_l, *ws_l):
+        b, row = _branch_of(jax.lax.axis_index(axis))
+        x_l = _pvary(x_l, (axis,))
+        g_l = _pvary(g_l, (axis,))
+        ws_l = _pvary(ws_l, all_axes)
+        dx, dws = jax.lax.switch(b, [_bwd_arm(i) for i in range(k)],
+                                 x_l, ws_l, g_l, row)
+        # every contribution is zero-padded to full shape: one psum over the
+        # placement axis assembles dx; weight grads sum over the whole mesh
+        # (each branch's arm zeroes the other branches' slots)
+        dx = jax.lax.psum(dx, axis)
+        dws = jax.lax.psum(dws, all_axes)
+        return dx, dws
+
+    bwd_sm = shard_map(_bwd_body, mesh=mesh,
+                       in_specs=(x_spec, o_spec) + w_specs,
+                       out_specs=(x_spec, w_specs))
+
+    @jax.custom_vjp
+    def run(x_, ws_):
+        return fwd_sm(x_, *ws_)
+
+    def run_fwd(x_, ws_):
+        return fwd_sm(x_, *ws_), (x_, ws_)
+
+    def run_bwd(res, g):
+        x_, ws_ = res
+        dx, dws = bwd_sm(x_, g, *ws_)
+        return dx, dws
+
+    run.defvjp(run_fwd, run_bwd)
+    return run(x, tuple(branch_weights))
+
+
 def place_branches_stacked(
     mesh: Mesh,
     axis: str,

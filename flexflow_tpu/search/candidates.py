@@ -89,7 +89,11 @@ def candidate_attrs(cand: "Candidate") -> Dict[str, str]:
     via LoweringCtx.op_attrs): inter:{axis} -> fork_join branch placement;
     sp_ring:{axis} -> ring-attention sequence parallelism."""
     if cand.name.startswith("inter:"):
-        return {"placement": cand.name.split(":", 1)[1]}
+        parts = cand.name.split(":")
+        attrs = {"placement": parts[1]}
+        if len(parts) > 2:  # unequal groups: "inter:model:3-1"
+            attrs["placement_groups"] = parts[2]
+        return attrs
     if cand.name.startswith("sp_ring:"):
         return {"seq_parallel": cand.name.split(":", 1)[1]}
     return {}
@@ -130,6 +134,35 @@ def _dp_dims(shape, machine: MachineSpec, batch_sizes) -> List[DimSharding]:
 
 def _ddeg(dims, machine):
     return cm.dims_degree(dims, machine)
+
+
+def _best_groups(costs, n: int, b_local: int):
+    """Best division of n axis indices among len(costs) branches minimizing
+    max_b(costs[b]/g_b), with each g_b dividing the per-device batch
+    (place_branches_grouped row-slices it). Exhaustive over divisor-valued
+    compositions — k is small (2-4 branches), n <= mesh axis size. Returns
+    (makespan_rel, group_sizes) or None when no valid composition exists."""
+    k = len(costs)
+    divs = [d for d in range(1, n + 1) if b_local % d == 0]
+    if n < k or not divs:
+        return None
+    best = None
+
+    def rec(i, left, acc):
+        nonlocal best
+        if i == k - 1:
+            if left in divs:
+                g = acc + [left]
+                mk = max(c / gi for c, gi in zip(costs, g))
+                if best is None or mk < best[0]:
+                    best = (mk, g)
+            return
+        for d in divs:
+            if d <= left - (k - 1 - i):
+                rec(i + 1, left - d, acc + [d])
+
+    rec(0, n, [])
+    return best
 
 
 def layer_candidates(layer: "Layer", machine: MachineSpec, batch_sizes,
@@ -311,33 +344,64 @@ def layer_candidates(layer: "Layer", machine: MachineSpec, batch_sizes,
         # switch-based placement stacks branch outputs: all branch shapes
         # must be equal, and stateful sub-ops (batch_norm running stats,
         # cache) cannot thread state through the shard_map body
-        from flexflow_tpu.ops.fork_join import congruent_branches, inter_placeable
+        from flexflow_tpu.ops.fork_join import (
+            branch_flops,
+            branch_weight_bytes,
+            congruent_branches,
+            grouped_placeable,
+            inter_placeable,
+        )
 
-        if not inter_placeable(layer):
-            return cands
         stacked = congruent_branches(layer)
+        b_local = (ispecs[0].shape[0] // max(1, _ddeg([dp_in[0][0]], machine))
+                   if ispecs and ispecs[0].ndim else 1)
         for m in maxes:
-            if machine.mesh_axes[m] != k:
-                continue
+            n = machine.mesh_axes[m]
             out_bytes = cm.shard_bytes(ospecs[0], dp_out[0], machine)
-            comm = (cm.all_reduce_time(out_bytes, (m,), machine) if join == "add"
-                    else cm.all_gather_time(out_bytes, (m,), machine))
-            if stacked:
-                # owned-device residency: stacked (k, ...) weights sharded
-                # over the placement axis — memory, streaming AND grad
-                # all-reduce all divide by k (grad_sync sees the shard)
-                wd = {w: [m] for w in layer.weight_specs}
-                frac = 1.0
-            else:
-                # heterogeneous branches: full replication (union resident
-                # everywhere), each device STREAMS only its branch's share
-                wd = dict(repl_w)
-                frac = 1.0 / k
-            cands.append(Candidate(
-                f"inter:{m}", dp_in, dp_out, wd,
-                compute_degree=max(1, dp.compute_degree) * k,
-                extra_comm=comm,
-                weight_stream_frac=frac))
+            if n == k and inter_placeable(layer):
+                comm = (cm.all_reduce_time(out_bytes, (m,), machine)
+                        if join == "add"
+                        else cm.all_gather_time(out_bytes, (m,), machine))
+                if stacked:
+                    # owned-device residency: stacked (k, ...) weights
+                    # sharded over the placement axis — memory, streaming
+                    # AND grad all-reduce all divide by k (grad_sync sees
+                    # the shard)
+                    wd = {w: [m] for w in layer.weight_specs}
+                    frac = 1.0
+                else:
+                    # heterogeneous branches: full replication (union
+                    # resident everywhere), each device STREAMS only its
+                    # branch's share
+                    wd = dict(repl_w)
+                    frac = 1.0 / k
+                cands.append(Candidate(
+                    f"inter:{m}", dp_in, dp_out, wd,
+                    compute_degree=max(1, dp.compute_degree) * k,
+                    extra_comm=comm,
+                    weight_stream_frac=frac))
+            elif n > k and grouped_placeable(layer):
+                # UNEQUAL resource division (reference graph.cc:267-321):
+                # branch b owns g_b axis indices, batch-shards g_b ways
+                # inside its group; group sizes must divide the per-device
+                # batch (the kernel row-slices it). Weights replicate.
+                costs = [max(f, 1.0) for f in branch_flops(layer)]
+                best = _best_groups(costs, n, b_local)
+                if best is None:
+                    continue
+                makespan_rel, gsz = best
+                speedup = sum(costs) / max(makespan_rel, 1e-30)
+                wb = branch_weight_bytes(layer)
+                frac = (max(wb) / sum(wb)) if sum(wb) else 1.0
+                # join rides one psum of the full joined output over the
+                # axis (assembles batch slices AND joins in one collective)
+                comm = cm.all_reduce_time(out_bytes, (m,), machine)
+                cands.append(Candidate(
+                    f"inter:{m}:{'-'.join(map(str, gsz))}",
+                    dp_in, dp_out, dict(repl_w),
+                    compute_degree=max(1, dp.compute_degree) * speedup,
+                    extra_comm=comm,
+                    weight_stream_frac=frac))
 
     elif t in UNARY_OPS or t in (OperatorType.DROPOUT, OperatorType.CAST,
                                  OperatorType.SOFTMAX, OperatorType.LOG_SOFTMAX):

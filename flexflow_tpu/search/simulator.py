@@ -220,9 +220,13 @@ def build_step_tasks(model, choices: Dict[str, Candidate], machine: MachineSpec,
         # --- split op time into fwd / bwd pure compute + inherent comm
         op_comm = cand.extra_comm + cm.grad_sync_time(
             layer.weight_specs, cand.weight_dims, machine, batch_axes)
-        fwd_t = bwd_t = None
-        if cost_fn is not None and hasattr(cost_fn, "op_times"):
-            fwd_t, bwd_t = cost_fn.op_times(layer, cand)
+        # the measured path passes the BOUND METHOD MeasuredCost.op_time as
+        # cost_fn (optimize.py) — recover the measurer through __self__ so
+        # the independently timed fwd/bwd split is actually used
+        measurer = getattr(getattr(cost_fn, "__self__", None), "op_times",
+                           None) or getattr(cost_fn, "op_times", None)
+        if measurer is not None:
+            fwd_t, bwd_t = measurer(layer, cand)
         else:
             total = cost_fn(layer, cand) if cost_fn else cand.op_time(layer, machine)
             comp = max(0.0, total - op_comm)
@@ -256,11 +260,12 @@ def build_step_tasks(model, choices: Dict[str, Candidate], machine: MachineSpec,
         # *fwd* task; approximating the collective as the last stage, we
         # chain it after fwd and splice consumers after it via an anchor.
         if cand.extra_comm > 0:
+            # candidate names encode the axis as the SECOND token
+            # ("tp_row:model", "inter:model:3-1" — groups come after)
             link = "link:_"
-            for ax in cm._axes_of(cand.name.split(":", 1)[1]) \
-                    if ":" in cand.name else ():
-                if machine.mesh_axes.get(ax, 1) > 1:
-                    link = f"link:{ax}"
+            parts = cand.name.split(":")
+            if len(parts) > 1 and machine.mesh_axes.get(parts[1], 1) > 1:
+                link = f"link:{parts[1]}"
             anchor = SimTask(f"{layer.name}:coll-anchor", "comp", "mxu", 0.0)
             tasks.append(anchor)
             out_bytes = sum(cm.shard_bytes(o.spec, list(

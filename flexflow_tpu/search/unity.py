@@ -350,14 +350,30 @@ def unity_optimize(model, machine: MachineSpec, cost_fn=None) -> Tuple[Strategy,
 
     for si, (seg, k) in enumerate(zip(segments, keys)):
         best = best_r = None
+        refined_done = False
         if k in memo:
-            path, base_cost = memo[k]
+            path, base_cost, rnames = memo[k]
             replayed = replay_path(seg, xfers, path)
             if replayed is not None:
                 try:
-                    best, best_r = replayed, _cost_pcg(replayed)
+                    if rnames is not None:
+                        # structurally identical segment: re-apply the
+                        # already-refined candidate choices BY NAME via pins
+                        # (topo positions coincide) instead of re-running
+                        # the topk DP + event replays per repetition
+                        pins = {l.name: nm for l, nm in
+                                zip(topo_order(replayed.layers), rnames)}
+                        best_r = search_graph(
+                            replayed, machine, beam_width=beam_width,
+                            mem_budget=mem_budget, cost_fn=cost_fn,
+                            enable_parameter=en_param,
+                            enable_attribute=en_attr, pins=pins)
+                        best, refined_done = replayed, True
+                    else:
+                        best, best_r = replayed, _cost_pcg(replayed)
                 except (KeyError, RuntimeError):
                     best = best_r = None
+                    refined_done = False
             if best is not None:
                 stats_all.segments_replayed += 1
                 stats_all.baseline_cost += base_cost
@@ -371,19 +387,24 @@ def unity_optimize(model, machine: MachineSpec, cost_fn=None) -> Tuple[Strategy,
                 mem_budget=mem_budget, cost_fn=cost_fn,
                 enable_parameter=en_param, enable_attribute=en_attr)
             budget_left = max(0, budget_left - stats.expansions)
-            memo[k] = (stats.best_path, stats.baseline_cost)
+            memo[k] = (stats.best_path, stats.baseline_cost, None)
             stats_all.expansions += stats.expansions
             stats_all.generated += stats.generated
             stats_all.deduped += stats.deduped
             stats_all.pruned += stats.pruned
             stats_all.baseline_cost += stats.baseline_cost
             stats_all.best_cost += stats.best_cost
-        refined = _sim_refine(best, best_r)
-        if refined is not best_r:
-            # keep the reported totals describing the RETURNED strategy:
-            # the re-rank may pick a finalist whose additive cost differs
-            stats_all.best_cost += refined.cost - best_r.cost
-            best_r = refined
+        if not refined_done:
+            refined = _sim_refine(best, best_r)
+            if refined is not best_r:
+                # keep the reported totals describing the RETURNED strategy:
+                # the re-rank may pick a finalist whose additive cost differs
+                stats_all.best_cost += refined.cost - best_r.cost
+                best_r = refined
+            if cfg.simulator_mode == "taskgraph" and k in memo:
+                memo[k] = (memo[k][0], memo[k][1],
+                           [best_r.choices[l.name].name
+                            for l in topo_order(best.layers)])
         strategy_from_pcg(best, machine, best_r, model_layer_names,
                           model_input_names, strategy=st)
     st.name = (f"unity(cost={stats_all.best_cost * 1e3:.3f}ms, "
