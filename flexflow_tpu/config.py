@@ -80,6 +80,16 @@ class FFConfig:
 
     @staticmethod
     def parse_args(argv: Optional[List[str]] = None) -> "FFConfig":
+        # FF_LAUNCH_ARGS: machine config injected by the Jupyter kernelspec
+        # (flexflow_tpu/jupyter — the reference custom-kernel analog) or a
+        # launcher wrapper; explicit argv/CLI flags override it
+        import shlex
+
+        env_args = shlex.split(os.environ.get("FF_LAUNCH_ARGS", ""))
+        if env_args:
+            import sys
+
+            argv = env_args + list(sys.argv[1:] if argv is None else argv)
         p = argparse.ArgumentParser("flexflow_tpu", allow_abbrev=False)
         p.add_argument("-e", "--epochs", type=int, default=1)
         p.add_argument("-b", "--batch-size", type=int, default=64)

@@ -1,0 +1,86 @@
+"""Jupyter kernel integration — run the framework interactively.
+
+Reference analog: `jupyter_notebook/` (install.py + flexflow_jupyter.json +
+flexflow_kernel_nocr.py): the reference must launch a CUSTOM kernel because
+its runtime (Legion) has to own the process and be configured with machine
+flags (-ll:gpu, -ll:fsize, ...) BEFORE user code runs. The TPU runtime needs
+no process takeover — JAX initializes lazily — so the analog is a standard
+ipykernel kernelspec whose launch ENVIRONMENT carries the machine
+configuration: FF launch flags (mesh shape, search budget, ...) in
+`FF_LAUNCH_ARGS` (consumed by FFConfig.from_env / the launcher), the
+platform pin in `FLEXFLOW_PLATFORM`, and XLA device-count flags for
+virtual-mesh notebooks.
+
+`python -m flexflow_tpu.jupyter.install --config cfg.json` installs the
+kernelspec; `load_config` maps the reference's flexflow_jupyter.json field
+vocabulary onto FF flags so existing configs carry over.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional, Tuple
+
+# reference flexflow_jupyter.json fields -> FF launcher flags. Legion-only
+# memory knobs (sysmem/fbmem/zcmem/regmem, utility/openmp threads) have no
+# TPU meaning and are accepted-but-dropped with a note, like the launcher
+# does for -ll: flags it subsumes.
+_FIELD_TO_FLAG = {
+    "nodes": "--nodes",
+    "ranks_per_node": "--workers-per-node",
+    "gpus": "--workers-per-node",  # per-node accelerator count
+    "batch_size": "-b",
+    "epochs": "-e",
+    "budget": "--budget",
+    "mesh": "--mesh",
+}
+_DROPPED_FIELDS = ("cpus", "openmp", "ompthreads", "utility", "sysmem",
+                   "fbmem", "zcmem", "regmem", "not_control_replicable",
+                   "launcher", "other_options")
+
+
+def load_config(path: str) -> Tuple[str, List[str], Dict[str, str]]:
+    """Parse a kernel config (reference flexflow_jupyter.json vocabulary or
+    the native one) -> (display_name, ff_argv, extra_env)."""
+    with open(path) as f:
+        cfg = json.load(f)
+    name = cfg.get("name", "FlexFlow TPU")
+    argv: List[str] = []
+    for field, flag in _FIELD_TO_FLAG.items():
+        v = cfg.get(field)
+        if isinstance(v, dict):  # reference style: {"cmd": ..., "value": ...}
+            v = v.get("value")
+        if v is None:
+            continue
+        if flag not in argv:
+            argv += [flag, str(v)]
+    env = dict(cfg.get("env", {}))
+    if cfg.get("platform"):
+        env["FLEXFLOW_PLATFORM"] = cfg["platform"]
+    if cfg.get("virtual_devices"):
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                            f" --xla_force_host_platform_device_count="
+                            f"{int(cfg['virtual_devices'])}").strip()
+        env.setdefault("FLEXFLOW_PLATFORM", "cpu")
+    return name, argv, env
+
+
+def kernelspec(display_name: str, ff_argv: List[str],
+               extra_env: Optional[Dict[str, str]] = None) -> dict:
+    """The kernel.json body: plain ipykernel launch with the FF machine
+    configuration riding the environment (the no-process-takeover analog of
+    the reference's custom kernel_json argv)."""
+    import shlex
+    import sys
+
+    # shlex round-trip: FFConfig.parse_args consumes FF_LAUNCH_ARGS with
+    # shlex.split, so values containing spaces must be quoted here
+    spec = {
+        "argv": [sys.executable, "-m", "ipykernel_launcher",
+                 "-f", "{connection_file}"],
+        "display_name": display_name,
+        "language": "python",
+        "env": {"FF_LAUNCH_ARGS": shlex.join(ff_argv), **(extra_env or {})},
+    }
+    return spec

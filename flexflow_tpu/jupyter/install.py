@@ -1,0 +1,76 @@
+"""Kernelspec installer — `python -m flexflow_tpu.jupyter.install`.
+
+Reference analog: `jupyter_notebook/install.py` (KernelSpecManager-based
+registration of the custom Legion kernel). Here the spec is a plain
+ipykernel launch carrying the FF machine config in its environment
+(see flexflow_tpu/jupyter/__init__.py), written either through
+jupyter_client's KernelSpecManager when available or directly into the
+kernels directory (--prefix) so the installer works without jupyter
+installed (e.g. building container images).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+from typing import Optional
+
+from flexflow_tpu.jupyter import kernelspec, load_config
+
+
+def install(config: Optional[str] = None, kernel_name: str = "flexflow_tpu",
+            display_name: Optional[str] = None, user: bool = True,
+            prefix: Optional[str] = None, ff_args: Optional[str] = None,
+            mute: bool = False) -> str:
+    """Write the kernelspec; returns the resource directory. `prefix` wins
+    over jupyter_client discovery (reference install.py --prefix)."""
+    name, argv, env = load_config(config) if config else ("FlexFlow TPU", [], {})
+    if ff_args:
+        import shlex
+
+        argv += shlex.split(ff_args)
+    spec = kernelspec(display_name or name, argv, env)
+
+    if prefix:
+        kdir = os.path.join(prefix, "share", "jupyter", "kernels", kernel_name)
+    else:
+        try:
+            from jupyter_client.kernelspec import KernelSpecManager
+
+            base = KernelSpecManager().user_kernel_dir if user else \
+                os.path.join(os.sys.prefix, "share", "jupyter", "kernels")
+            kdir = os.path.join(base, kernel_name)
+        except ImportError:
+            kdir = os.path.join(os.path.expanduser("~"), ".local", "share",
+                                "jupyter", "kernels", kernel_name)
+    os.makedirs(kdir, exist_ok=True)
+    with open(os.path.join(kdir, "kernel.json"), "w") as f:
+        json.dump(spec, f, indent=2, sort_keys=True)
+    if not mute:
+        print(f"installed kernelspec {kernel_name!r} -> {kdir}")
+        print(f"  display_name: {spec['display_name']}")
+        print(f"  FF_LAUNCH_ARGS: {spec['env'].get('FF_LAUNCH_ARGS', '')!r}")
+    return kdir
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser("flexflow_tpu.jupyter.install")
+    p.add_argument("--config", default=None,
+                   help="kernel config JSON (reference flexflow_jupyter.json "
+                        "vocabulary accepted)")
+    p.add_argument("--kernel-name", default="flexflow_tpu")
+    p.add_argument("--display-name", default=None)
+    p.add_argument("--prefix", default=None)
+    p.add_argument("--system", action="store_true",
+                   help="install system-wide instead of per-user")
+    p.add_argument("--ff-args", default=None,
+                   help='extra launcher flags, e.g. "--mesh data=4,model=2"')
+    args = p.parse_args(argv)
+    install(config=args.config, kernel_name=args.kernel_name,
+            display_name=args.display_name, user=not args.system,
+            prefix=args.prefix, ff_args=args.ff_args)
+
+
+if __name__ == "__main__":
+    main()

@@ -44,7 +44,7 @@ executed that branch — the same all-reduce semantics as data parallelism.
 
 from __future__ import annotations
 
-from typing import Callable, List, Sequence
+from typing import Callable, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -65,6 +65,34 @@ def _pvary(x, axes):
         return jax.lax.pvary(x, tuple(axes))
 
 
+def _batch_pspec(mesh: Mesh, axis: str, batch_len: int,
+                 batch_axes=None):
+    """Batch-dim sharding for a placement body — MIRRORS the search's
+    _dp_dims convention (search/candidates.py) so the divisibility the
+    candidate assumed holds at lowering: node+data jointly when their
+    product divides the batch, else the first axis that divides, else
+    replicated. Explicit `batch_axes` (tests / manual callers) filters by
+    per-axis divisibility as before."""
+    if batch_axes is not None:
+        db = [a for a in batch_axes if a in mesh.shape and a != axis
+              and batch_len % mesh.shape[a] == 0]
+    else:
+        cand = [a for a in ("node", "data") if a in mesh.shape and a != axis]
+        deg = 1
+        for a in cand:
+            deg *= mesh.shape[a]
+        if len(cand) > 1 and batch_len % deg == 0:
+            db = cand
+        else:
+            db = next(([a] for a in cand if batch_len % mesh.shape[a] == 0),
+                      [])
+    bspec = tuple(db) if len(db) > 1 else (db[0] if db else None)
+    b_local = batch_len
+    for a in db:
+        b_local //= mesh.shape[a]
+    return db, bspec, b_local
+
+
 def place_branches(
     mesh: Mesh,
     axis: str,
@@ -72,7 +100,7 @@ def place_branches(
     x: jax.Array,
     branch_weights: Sequence,
     join: str,
-    batch_axes: Sequence[str] = ("data",),
+    batch_axes: Optional[Sequence[str]] = None,
 ):
     """Run branch i of `branch_fns` on mesh-axis index i only.
 
@@ -91,9 +119,7 @@ def place_branches(
         raise ValueError(f"unsupported join {join!r}")
 
     # batch dim rides the data axes; everything else is replicated
-    db = [a for a in batch_axes if a in mesh.shape and a != axis
-          and x.shape[0] % mesh.shape[a] == 0]
-    bspec = tuple(db) if len(db) > 1 else (db[0] if db else None)
+    _db, bspec, _bl = _batch_pspec(mesh, axis, x.shape[0], batch_axes)
     x_spec = PartitionSpec(bspec, *([None] * (x.ndim - 1)))
     w_specs = jax.tree_util.tree_map(lambda _: PartitionSpec(),
                                      tuple(branch_weights))
@@ -194,7 +220,7 @@ def place_branches_grouped(
     group_sizes: Sequence[int],
     out_dims: Sequence[int],
     out_ndim: int,
-    batch_axes: Sequence[str] = ("data",),
+    batch_axes: Optional[Sequence[str]] = None,
 ):
     """UNEQUAL resource division: branch b owns a contiguous group of
     `group_sizes[b]` indices of the placement axis (sum == axis size), the
@@ -227,12 +253,7 @@ def place_branches_grouped(
     feat_off = [0] * k if join == "add" else \
         [sum(out_dims[:b]) for b in range(k)]
 
-    db = [a for a in batch_axes if a in mesh.shape and a != axis
-          and x.shape[0] % mesh.shape[a] == 0]
-    bspec = tuple(db) if len(db) > 1 else (db[0] if db else None)
-    b_local = x.shape[0]
-    for a in db:
-        b_local //= mesh.shape[a]
+    _db, bspec, b_local = _batch_pspec(mesh, axis, x.shape[0], batch_axes)
     for g in group_sizes:
         if b_local % g:
             raise ValueError(
@@ -338,7 +359,7 @@ def place_branches_stacked(
     x: jax.Array,
     stacked_weights,
     join: str,
-    batch_axes: Sequence[str] = ("data",),
+    batch_axes: Optional[Sequence[str]] = None,
 ):
     """Owned-device variant: `stacked_weights` is one pytree whose leaves are
     (k, ...) arrays — leaf [i] is branch i's weight — sharded over the
@@ -357,9 +378,7 @@ def place_branches_stacked(
     if join not in ("add", "concat"):
         raise ValueError(f"unsupported join {join!r}")
 
-    db = [a for a in batch_axes if a in mesh.shape and a != axis
-          and x.shape[0] % mesh.shape[a] == 0]
-    bspec = tuple(db) if len(db) > 1 else (db[0] if db else None)
+    _db, bspec, _bl = _batch_pspec(mesh, axis, x.shape[0], batch_axes)
     x_spec = PartitionSpec(bspec, *([None] * (x.ndim - 1)))
     w_spec = jax.tree_util.tree_map(lambda _: PartitionSpec(axis),
                                     stacked_weights)

@@ -312,7 +312,9 @@ def unity_optimize(model, machine: MachineSpec, cost_fn=None) -> Tuple[Strategy,
     beam_width = max(16, min(cfg.search_budget, 64))
     keys = [seg.key() for seg in segments]
     budget_left = max(8, cfg.search_budget)
-    memo: Dict[Tuple, Tuple] = {}  # seg key -> (path, baseline_cost)
+    # seg key -> (rewrite path, baseline_cost, refined candidate names in
+    # topo order once taskgraph refinement ran — replayed as pins — or None)
+    memo: Dict[Tuple, Tuple] = {}
     st = Strategy(mesh_axes=dict(machine.mesh_axes), name="unity")
     model_layer_names = {l.name for l in model.layers}
     model_input_names = {t.name for t in model.input_tensors}
