@@ -7,7 +7,7 @@ flags (-ll:gpu, -ll:fsize, ...) BEFORE user code runs. The TPU runtime needs
 no process takeover — JAX initializes lazily — so the analog is a standard
 ipykernel kernelspec whose launch ENVIRONMENT carries the machine
 configuration: FF launch flags (mesh shape, search budget, ...) in
-`FF_LAUNCH_ARGS` (consumed by FFConfig.from_env / the launcher), the
+`FF_LAUNCH_ARGS` (consumed by FFConfig.parse_args / the launcher), the
 platform pin in `FLEXFLOW_PLATFORM`, and XLA device-count flags for
 virtual-mesh notebooks.
 
@@ -24,12 +24,10 @@ from typing import Dict, List, Optional, Tuple
 
 # reference flexflow_jupyter.json fields -> FF launcher flags. Legion-only
 # memory knobs (sysmem/fbmem/zcmem/regmem, utility/openmp threads) have no
-# TPU meaning and are accepted-but-dropped with a note, like the launcher
-# does for -ll: flags it subsumes.
+# TPU meaning and are dropped with a warning, like the launcher does for
+# -ll: flags it subsumes.
 _FIELD_TO_FLAG = {
     "nodes": "--nodes",
-    "ranks_per_node": "--workers-per-node",
-    "gpus": "--workers-per-node",  # per-node accelerator count
     "batch_size": "-b",
     "epochs": "-e",
     "budget": "--budget",
@@ -40,6 +38,13 @@ _DROPPED_FIELDS = ("cpus", "openmp", "ompthreads", "utility", "sysmem",
                    "launcher", "other_options")
 
 
+def _value(cfg: dict, field: str):
+    v = cfg.get(field)
+    if isinstance(v, dict):  # reference style: {"cmd": ..., "value": ...}
+        v = v.get("value")
+    return v
+
+
 def load_config(path: str) -> Tuple[str, List[str], Dict[str, str]]:
     """Parse a kernel config (reference flexflow_jupyter.json vocabulary or
     the native one) -> (display_name, ff_argv, extra_env)."""
@@ -48,13 +53,22 @@ def load_config(path: str) -> Tuple[str, List[str], Dict[str, str]]:
     name = cfg.get("name", "FlexFlow TPU")
     argv: List[str] = []
     for field, flag in _FIELD_TO_FLAG.items():
-        v = cfg.get(field)
-        if isinstance(v, dict):  # reference style: {"cmd": ..., "value": ...}
-            v = v.get("value")
-        if v is None:
-            continue
-        if flag not in argv:
+        v = _value(cfg, field)
+        if v is not None:
             argv += [flag, str(v)]
+    # per-node worker count: ranks_per_node x gpus-per-rank (the reference
+    # config typically sets both; the TPU launcher has one workers knob)
+    ranks, gpus = _value(cfg, "ranks_per_node"), _value(cfg, "gpus")
+    if ranks is not None or gpus is not None:
+        argv += ["--workers-per-node",
+                 str(int(ranks or 1) * int(gpus or 1))]
+    dropped = [f for f in _DROPPED_FIELDS if _value(cfg, f) is not None]
+    if dropped:
+        import warnings
+
+        warnings.warn(f"kernel config fields with no TPU meaning dropped: "
+                      f"{dropped} (Legion machine knobs; the XLA runtime "
+                      f"manages memory itself)")
     env = dict(cfg.get("env", {}))
     if cfg.get("platform"):
         env["FLEXFLOW_PLATFORM"] = cfg["platform"]

@@ -42,8 +42,10 @@ def install(config: Optional[str] = None, kernel_name: str = "flexflow_tpu",
                 os.path.join(os.sys.prefix, "share", "jupyter", "kernels")
             kdir = os.path.join(base, kernel_name)
         except ImportError:
-            kdir = os.path.join(os.path.expanduser("~"), ".local", "share",
-                                "jupyter", "kernels", kernel_name)
+            base = os.path.join(os.path.expanduser("~"), ".local", "share",
+                                "jupyter", "kernels") if user else \
+                os.path.join(os.sys.prefix, "share", "jupyter", "kernels")
+            kdir = os.path.join(base, kernel_name)
     os.makedirs(kdir, exist_ok=True)
     with open(os.path.join(kdir, "kernel.json"), "w") as f:
         json.dump(spec, f, indent=2, sort_keys=True)

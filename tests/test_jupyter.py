@@ -45,14 +45,17 @@ def test_reference_config_vocabulary(tmp_path):
     cfg.write_text(json.dumps({
         "name": "FlexFlow",
         "gpus": {"cmd": "-ll:gpu", "value": 4},
+        "ranks_per_node": {"cmd": "--npernode", "value": 2},
         "nodes": {"cmd": "-n", "value": 2},
         "fbmem": {"cmd": "-ll:fsize", "value": 4096},
         "sysmem": {"cmd": "-ll:csize", "value": None},
     }))
-    name, argv, env = load_config(str(cfg))
+    with pytest.warns(UserWarning, match="no TPU meaning"):
+        name, argv, env = load_config(str(cfg))
     assert name == "FlexFlow"
     assert argv[argv.index("--nodes") + 1] == "2"
-    assert argv[argv.index("--workers-per-node") + 1] == "4"
+    # per-node workers = ranks_per_node x gpus-per-rank
+    assert argv[argv.index("--workers-per-node") + 1] == "8"
     assert "-ll:fsize" not in argv  # no TPU meaning
 
 
