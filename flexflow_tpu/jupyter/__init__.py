@@ -70,12 +70,14 @@ def load_config(path: str) -> Tuple[str, List[str], Dict[str, str]]:
                       f"{dropped} (Legion machine knobs; the XLA runtime "
                       f"manages memory itself)")
     env = dict(cfg.get("env", {}))
-    if cfg.get("platform"):
-        env["FLEXFLOW_PLATFORM"] = cfg["platform"]
-    if cfg.get("virtual_devices"):
+    platform = _value(cfg, "platform")
+    if platform:
+        env["FLEXFLOW_PLATFORM"] = str(platform)
+    vdev = _value(cfg, "virtual_devices")
+    if vdev:
         env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
                             f" --xla_force_host_platform_device_count="
-                            f"{int(cfg['virtual_devices'])}").strip()
+                            f"{int(vdev)}").strip()
         env.setdefault("FLEXFLOW_PLATFORM", "cpu")
     return name, argv, env
 

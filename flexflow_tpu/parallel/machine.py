@@ -56,12 +56,16 @@ class MachineSpec:
     # concurrent compute+transfer replay, simulator.h:785-827 — here a
     # closed-form factor): fraction of a segment's pure-compute time that
     # XLA's async collectives / latency-hiding scheduler can hide collective
-    # time behind. 0 = fully additive costing. The on-chip DMA-behind-matmul
-    # proxy measures a ceiling of 1.00 (CALIBRATION.md: an independent
-    # 256 MB reduction hides completely behind a matmul chain); the default
-    # stays below it because real collectives sit on dataflow edges (their
-    # producer must finish first), so only part of the consumer's compute
-    # window is usable in the worst case.
+    # time behind. 0 = fully additive costing. Collectives are async
+    # ICI/HBM DMAs, which genuinely overlap compute; the single-chip
+    # compute proxy CANNOT observe this (a TPU core runs compute HLOs
+    # serially — CALIBRATION.md's negative control). The 0.7 default rests
+    # on the async-DMA architecture, stays below 1.0 because collectives
+    # sit on dataflow edges (their producer must finish first), and is
+    # cross-checked by the whole-model scheduling calibration
+    # (CALIBRATION.md simulated/step ~0.94). search/simulator.py replaces
+    # this factor entirely with event-driven replay (simulator_mode=
+    # "taskgraph").
     overlap_frac: float = 0.7
 
     def __post_init__(self):
