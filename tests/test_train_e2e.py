@@ -102,3 +102,46 @@ def test_forward_inference():
     y = np.asarray(m.forward(np.ones((4, 4), np.float32)))
     assert y.shape == (4, 3)
     np.testing.assert_allclose(y.sum(-1), 1.0, rtol=1e-5)
+
+
+def test_adam_bf16_state_numerics_and_quality():
+    """Opt-in reduced-precision Adam moments (AdamOptimizer state_dtype=
+    "bfloat16", halving optimizer-state memory/HBM traffic — see
+    tools/perf_probe.py): one update must closely track fp32-state optax
+    adam, the carried moments must actually be bf16, and end-to-end
+    training quality must match the fp32-state run."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    # single-step numerics vs reference optax.adam
+    rng = np.random.default_rng(0)
+    params = {"w": jnp.asarray(rng.normal(size=(64, 64)), jnp.float32)}
+    grads = {"w": jnp.asarray(rng.normal(size=(64, 64)), jnp.float32)}
+    lo = AdamOptimizer(alpha=0.001, state_dtype="bfloat16").to_optax()
+    hi = optax.chain(optax.scale_by_adam(), optax.scale(-0.001))
+    slo, shi = lo.init(params), hi.init(params)
+    ulo, slo = lo.update(grads, slo, params)
+    uhi, shi = hi.update(grads, shi, params)
+    np.testing.assert_allclose(np.asarray(ulo["w"]), np.asarray(uhi["w"]),
+                               rtol=2e-2, atol=2e-5)
+    assert slo[0].mu["w"].dtype == jnp.bfloat16
+    assert slo[0].nu["w"].dtype == jnp.bfloat16
+
+    # end-to-end: bf16-state training reaches the same quality bar
+    def run(state_dtype):
+        rng2 = np.random.default_rng(1)
+        x, y = make_blobs(512, 16, 4, rng2)
+        cfg = FFConfig(batch_size=64, epochs=4, only_data_parallel=True)
+        m = FFModel(cfg)
+        t = m.create_tensor([64, 16], name="x")
+        h = m.dense(t, 64, activation="relu")
+        m.dense(h, 4)
+        m.compile(AdamOptimizer(alpha=0.01, state_dtype=state_dtype),
+                  LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+                  [MetricsType.ACCURACY])
+        return m.fit(x, y, verbose=False)[-1]["accuracy"]
+
+    acc_lo, acc_hi = run("bfloat16"), run("float32")
+    assert acc_lo > 0.8, acc_lo
+    assert acc_lo > acc_hi - 0.05, (acc_lo, acc_hi)

@@ -314,8 +314,8 @@ def write_report(rows, machine, path="CALIBRATION.md", overlap=None):
              "segment's pure-compute time worth of collective cost)"
              if overlap["overlap_frac"] is not None else
              "- **measurement degenerate this run** (a kernel timed at ~0 "
-             "through the tunnel-fetch noise floor after 3 attempts); the "
-             "default overlap_frac=0.7 from the last good run stands"),
+             "through the tunnel-fetch noise floor); the default "
+             "overlap_frac=0.7 stands on its documented rationale"),
             "",
         ]
     with open(path, "w") as f:

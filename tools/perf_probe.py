@@ -9,7 +9,11 @@ and prints the deltas:
   fwd_only       forward pass alone (bwd+update = step - fwd)
 
 All timings use the bench protocol: chained steps, one-scalar host fetch,
-calibrated tunnel-floor subtraction, median of windows.
+calibrated tunnel-floor subtraction, median of windows. The protocol is
+deliberately inlined in each harness that carries it (bench.py
+_bench_model — kept self-contained as the driver-run artifact —
+search/measure.py MeasuredCost._time, tools/calibrate.py t_chained, and
+here): a future tunnel-timing fix must be applied to all four.
 
     python tools/perf_probe.py [--iters 20] [--windows 3]
 """
