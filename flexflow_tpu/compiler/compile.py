@@ -443,11 +443,23 @@ class CompiledModel:
                     for d in (dims or [])]
 
         if sh is not None:
+            from flexflow_tpu.search.candidates import candidate_attrs
+
             want_w = {w: norm(d) for w, d in sh.weights.items()}
+            want_attrs = dict(sh.attrs or {})
+            # attrs disambiguate candidates with identical weight layouts
+            # (a grouped inter: placement keeps weights replicated like dp);
+            # fall back to the first layout-only match in the same scan
+            layout_match = None
             for c in cands:
-                if {w: norm(d) for w, d in c.weight_dims.items()} == want_w \
-                        and not c.passthrough:
+                if c.passthrough or \
+                        {w: norm(d) for w, d in c.weight_dims.items()} != want_w:
+                    continue
+                if candidate_attrs(c) == want_attrs:
                     return c
+                layout_match = layout_match or c
+            if layout_match is not None:
+                return layout_match
         return cands[0]
 
     def profile_report(self, top: int = 0, print_table: bool = True):
@@ -481,6 +493,21 @@ class CompiledModel:
                       f"{x['analytic_us']:9.1f}u {x['measured_us']:9.1f}u "
                       f"{100 * x['measured_us'] / total:4.1f}%")
         return rows
+
+    def export_sim_trace(self, path: str):
+        """Replay the COMPILED strategy through the event-driven simulator
+        and write a chrome-trace timeline (load in chrome://tracing /
+        perfetto) — the reference taskgraph simulator's export_file_name
+        analog. Wired to --simulator-trace. Returns the SimReport."""
+        from flexflow_tpu.search.simulator import simulate_strategy
+
+        choices = {l.name: self._candidate_for(l) for l in self.model.layers}
+        # same segmentation the search's re-rank used, so the exported
+        # timeline matches the simulation that ranked the strategy
+        report = simulate_strategy(self.model, choices, self.machine,
+                                   segment_bytes=self.cfg.simulator_segment_size)
+        report.export_trace(path)
+        return report
 
     # ------------------------------------------------- recompile-on-condition
     def recompile_on_condition(self, trigger_fn, alter_fn):

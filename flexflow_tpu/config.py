@@ -65,6 +65,10 @@ class FFConfig:
     # observability
     export_dot: str = ""  # --compgraph analog
     include_costs_dot_graph: bool = False
+    # chrome-trace export of the COMPILED strategy's event-driven replay
+    # (search/simulator.py SimReport.export_trace) — the taskgraph export
+    # analog of the reference simulator's export_file_name
+    simulator_trace: str = ""
     log_level: str = "info"
 
     @property
@@ -120,6 +124,7 @@ class FFConfig:
         p.add_argument("--simulator-segment-size", type=int,
                        default=16 * 1024 * 1024)
         p.add_argument("--simulator-topk", type=int, default=4)
+        p.add_argument("--simulator-trace", type=str, default="")
         p.add_argument("--machine-model-file", type=str, default="")
         p.add_argument("--fusion", dest="fusion", action="store_true", default=True)
         p.add_argument("--no-fusion", dest="fusion", action="store_false")
@@ -162,6 +167,7 @@ class FFConfig:
             simulator_mode=args.simulator_mode,
             simulator_segment_size=args.simulator_segment_size,
             simulator_topk=args.simulator_topk,
+            simulator_trace=args.simulator_trace,
             machine_model_file=args.machine_model_file,
             enable_fusion=args.fusion,
             profiling=args.profiling,

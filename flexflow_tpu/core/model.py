@@ -450,6 +450,8 @@ class FFModel:
         if self.config.export_dot:
             with open(self.config.export_dot, "w") as f:
                 f.write(self.dot())
+        if self.config.simulator_trace:
+            self._compiled.export_sim_trace(self.config.simulator_trace)
         return self._compiled
 
     @property

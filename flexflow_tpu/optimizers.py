@@ -100,7 +100,10 @@ class AdamOptimizer(Optimizer):
         self.epsilon = epsilon
         self.state_dtype = state_dtype
 
-    _STATE_DTYPES = ("float32", "bfloat16", "float16")
+    # bf16 only: it shares fp32's exponent range, so the stored nu moment
+    # cannot overflow. fp16 (max 65504) would overflow nu to inf for
+    # gradient elements |g| > ~810 and silently zero their updates forever.
+    _STATE_DTYPES = ("float32", "bfloat16")
 
     def to_optax(self) -> optax.GradientTransformation:
         sd = self.state_dtype or "float32"  # None/"" = default

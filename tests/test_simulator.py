@@ -202,3 +202,30 @@ def test_mcmc_taskgraph_evaluator():
                                    evaluator="taskgraph")
     assert stats.best_cost <= stats.init_cost
     assert st.op_shardings
+
+
+def test_simulator_trace_export_flag(tmp_path, devices):
+    """--simulator-trace: compiling writes a chrome trace of the compiled
+    strategy's event-driven replay (the reference simulator's
+    export_file_name analog), including comm tasks on link timelines."""
+    import json as _json
+
+    import numpy as np
+
+    from flexflow_tpu import FFModel, FFConfig, SGDOptimizer
+
+    out = tmp_path / "step_trace.json"
+    cfg = FFConfig(batch_size=16, mesh_shape={"data": 4, "model": 2},
+                   search_budget=8, simulator_trace=str(out))
+    m = FFModel(cfg)
+    x = m.create_tensor([16, 64], name="x")
+    h = m.dense(x, 2048, activation="relu", name="up")
+    m.dense(h, 64, name="down")
+    m.compile(SGDOptimizer(lr=0.01), "mean_squared_error", [])
+    data = _json.loads(out.read_text())
+    names = {e.get("name", "") for e in data["traceEvents"]}
+    assert any(n.startswith("up:fwd") for n in names), names
+    assert any(":gradsync" in n for n in names), names
+    # flag parse path
+    c2 = FFConfig.parse_args(["--simulator-trace", "/tmp/x.json"])
+    assert c2.simulator_trace == "/tmp/x.json"
