@@ -318,6 +318,57 @@ class CompiledModel:
         self.train_step = jax.jit(_wrap(train_step), donate_argnums=donate)
         self.eval_step = jax.jit(_wrap(eval_step))
         self.infer_step = jax.jit(_wrap(infer))
+        self._train_step_fn = train_step  # unjitted body for make_multi_step
+        self._wrap_precision = _wrap
+
+    def make_multi_step(self, n: int, donate: "Optional[bool]" = None):
+        """One-dispatch n-step training: fori_loop over n stacked batches
+        inside a single jitted program. The reference's analog is the Legion
+        trace replay its Python fit loop wraps around each iteration
+        (flexflow_cffi.py begin_trace/end_trace) — amortizing per-step
+        runtime overhead; here it amortizes per-step DISPATCH, which
+        dominates sub-10ms steps on high-latency transports (the axon
+        tunnel's ~ms per dispatch).
+
+        Returns jitted fn(params, opt_state, state, stacked_inputs,
+        stacked_labels, rng) -> (params, opt_state, state, mean_loss,
+        last_metrics); stacked arrays carry a leading n dim.
+
+        `donate=None` follows cfg.donate_state. CAUTION (same contract as
+        train_step): under donation the INPUT params/opt_state/state
+        buffers are consumed — if you pass cm.params etc., write the
+        returned trees back (cm.params, cm.opt_state, cm.state = p, o, s)
+        before touching any other CompiledModel method, or they will
+        dereference deleted arrays."""
+        import jax
+
+        if donate is None:
+            donate = self.cfg.donate_state
+        step = self._train_step_fn
+
+        def multi(params, opt_state, state, inputs, labels, rng):
+            def at(i, arrs):
+                return [jax.lax.dynamic_index_in_dim(a, i, keepdims=False)
+                        for a in arrs]
+
+            def body(i, carry):
+                p, o, s, loss_sum, _ = carry
+                p, o, s, loss, mv = step(
+                    p, o, s, at(i, inputs),
+                    jax.lax.dynamic_index_in_dim(labels, i, keepdims=False),
+                    jax.random.fold_in(rng, i))
+                return (p, o, s, loss_sum + loss, mv)
+
+            # step 0 outside the loop fixes the carry's loss/metric shapes
+            p, o, s, l0, mv0 = step(params, opt_state, state,
+                                    [a[0] for a in inputs], labels[0],
+                                    jax.random.fold_in(rng, 0))
+            p, o, s, lsum, mv = jax.lax.fori_loop(
+                1, n, body, (p, o, s, l0, mv0))
+            return p, o, s, lsum / n, mv
+
+        return jax.jit(self._wrap_precision(multi),
+                       donate_argnums=(0, 1, 2) if donate else ())
 
     def _coerce_batch(self, batch_size: Optional[int]) -> int:
         # batch must match the traced graph-input batch dim (XLA static shapes)
