@@ -408,5 +408,24 @@ def main():
     }))
 
 
+def _is_transient_tunnel_error(e: BaseException) -> bool:
+    """The axon tunnel occasionally drops a remote_compile / data stream
+    mid-flight (observed r5: 'read body: response body closed before all
+    bytes were read'); the next attempt usually succeeds."""
+    s = f"{type(e).__name__}: {e}"
+    return any(m in s for m in ("remote_compile", "read body",
+                                "UNAVAILABLE", "Connection reset",
+                                "Socket closed"))
+
+
 if __name__ == "__main__":
-    main()
+    for _attempt in range(3):
+        try:
+            main()
+            break
+        except Exception as e:  # noqa: BLE001 — retry transient tunnel drops
+            if _attempt == 2 or not _is_transient_tunnel_error(e):
+                raise
+            print(f"transient tunnel error (attempt {_attempt + 1}/3): {e}; "
+                  "retrying in 15s", file=sys.stderr)
+            time.sleep(15)

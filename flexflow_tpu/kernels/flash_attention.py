@@ -44,17 +44,22 @@ def flash_supported(seq: int, depth: int, itemsize: int = 4) -> bool:
     return False
 
 
-def _pick_block(s: int) -> int:
+def _pick_block(s: int, env: str = "FLEXFLOW_FLASH_BLOCK") -> int:
     import os
 
     try:
-        forced = int(os.environ.get("FLEXFLOW_FLASH_BLOCK", "0"))
+        forced = int(os.environ.get(env, "0") or "0")
     except ValueError:
         forced = 0
     # tuning override: only known-safe block sizes (VMEM budget was sized
     # for _BLOCK_CANDIDATES; arbitrary values could OOM Mosaic)
     if forced in _BLOCK_CANDIDATES and s % forced == 0:
         return forced
+    if env != "FLEXFLOW_FLASH_BLOCK":
+        # bwd knob unset OR invalid: inherit the main block choice (so a
+        # typo'd bwd value degrades to the fwd configuration, not to a
+        # third configuration nobody asked for)
+        return _pick_block(s)
     for b in _BLOCK_CANDIDATES:
         if s % b == 0:
             return b
@@ -221,8 +226,11 @@ def _bwd(causal, scale, res, g):
     q, k, v, o, lse = res
     b, h, sq, d = q.shape
     sk = k.shape[2]
-    bq = _pick_block(sq)
-    bk = _pick_block(sk)
+    # FLEXFLOW_FLASH_BLOCK_BWD tunes the backward independently (the dq /
+    # dkv kernels have different VMEM/recompute balance than the forward);
+    # unset = inherit FLEXFLOW_FLASH_BLOCK's choice
+    bq = _pick_block(sq, env="FLEXFLOW_FLASH_BLOCK_BWD")
+    bk = _pick_block(sk, env="FLEXFLOW_FLASH_BLOCK_BWD")
     do = g.astype(jnp.float32)
     delta = jnp.sum(do * o.astype(jnp.float32), axis=-1, keepdims=True)  # (b, h, sq, 1)
 
