@@ -82,7 +82,13 @@ def search_graph(model, machine: MachineSpec, beam_width: int = 64,
     choices pinned while the DP still lays out every unpinned op.
 
     `topk > 1` returns the best `topk` finalists (List[SearchResult], one per
-    distinct terminal frontier) for the event-driven simulator re-rank."""
+    distinct terminal frontier) for the event-driven simulator re-rank.
+    Diversity caveat: the beam keeps ONE best trace per frontier layout, so
+    chain-shaped models whose strategies converge to the same terminal
+    layout yield a single finalist — the re-rank then has nothing to decide
+    and taskgraph mode degrades gracefully to the additive choice. Interior
+    diversity (e.g. which layer to shard, the position-dependent-exposure
+    case) is exercised through the MCMC taskgraph evaluator instead."""
     layers = topo_order(model.layers)
     batch_sizes = {t.shape[0] for t in model.input_tensors if t.ndim > 0}
     mem_budget = mem_budget or machine.hbm_bytes
