@@ -481,3 +481,52 @@ def test_grouped_placement_trains_and_matches(devices):
     l1 = [float(cm1.fit(xv, yv, epochs=1)[-1]["loss"]) for _ in range(3)]
     l2 = [float(cm2.fit(xv, yv, epochs=1)[-1]["loss"]) for _ in range(3)]
     np.testing.assert_allclose(l1, l2, rtol=1e-3)
+
+
+def test_three_branch_unequal_division(devices):
+    """Three heterogeneous branches on an 8-way axis under an explicit
+    unequal (4, 2, 2) division: the placed execution (fwd AND gradients)
+    matches replicated numerics for add-join. (The cost-driven group
+    ALLOCATION is covered by test_search_finds_unequal_division; this
+    pins the k>2 kernel numerics at a division the search could emit.)"""
+    from flexflow_tpu.parallel.interop import place_branches_grouped
+
+    mesh = build_mesh(MachineSpec(mesh_axes={"model": 8}, chip="v5p"))
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(16, 16)), jnp.float32)
+    w_big = {"a": jnp.asarray(rng.normal(size=(16, 128)) * 0.1, jnp.float32),
+             "b": jnp.asarray(rng.normal(size=(128, 24)) * 0.1, jnp.float32)}
+    w_mid = {"a": jnp.asarray(rng.normal(size=(16, 48)) * 0.1, jnp.float32),
+             "b": jnp.asarray(rng.normal(size=(48, 24)) * 0.1, jnp.float32)}
+    w_sm = {"a": jnp.asarray(rng.normal(size=(16, 24)) * 0.1, jnp.float32)}
+
+    def big(xv, w):
+        return jnp.tanh(xv @ w["a"]) @ w["b"]
+
+    def mid(xv, w):
+        return jax.nn.relu(xv @ w["a"]) @ w["b"]
+
+    def small(xv, w):
+        return xv @ w["a"]
+
+    ref = big(x, w_big) + mid(x, w_mid) + small(x, w_sm)
+
+    def run(x_, ws):
+        # groups (4, 2, 2): local batch 16 divisible by each
+        return place_branches_grouped(mesh, "model", [big, mid, small], x_,
+                                      ws, "add", (4, 2, 2), [24, 24, 24], 2)
+
+    with mesh:
+        y = jax.jit(run)(x, (w_big, w_mid, w_sm))
+        g = jax.jit(jax.grad(lambda x_, ws: (run(x_, ws) ** 2).sum(),
+                             argnums=(0, 1)))(x, (w_big, w_mid, w_sm))
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref), atol=1e-5)
+
+    def ref_loss(x_, ws):
+        wb, wm, wsm = ws
+        return ((big(x_, wb) + mid(x_, wm) + small(x_, wsm)) ** 2).sum()
+
+    gr = jax.grad(ref_loss, argnums=(0, 1))(x, (w_big, w_mid, w_sm))
+    for a, b in zip(jax.tree_util.tree_leaves(g),
+                    jax.tree_util.tree_leaves(gr)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-3)

@@ -229,3 +229,27 @@ def test_simulator_trace_export_flag(tmp_path, devices):
     # flag parse path
     c2 = FFConfig.parse_args(["--simulator-trace", "/tmp/x.json"])
     assert c2.simulator_trace == "/tmp/x.json"
+
+
+def test_dcn_axis_priced_on_its_own_link():
+    """Multi-slice machine: grad syncs over the node+data batch axes bind
+    to the SLOWEST involved link (the DCN node axis — _link_of picks the
+    stage that dominates the hierarchical collective), while a tp layer's
+    all-reduce rides the ICI model link; DCN tasks carry the DCN-priced
+    duration."""
+    from flexflow_tpu.search import cost_model as cm
+
+    mach = MachineSpec(mesh_axes={"node": 2, "data": 2, "model": 2},
+                       chip="v5e", dcn_axes=("node",), overlap_frac=0.0)
+    m = chain_model(d=2048, n=4, b=16, s=256)
+    choices, _ = plan(m, mach, shard=("fc1",))
+    rep = simulate_strategy(m, choices, mach)
+    links = {t.resource for t in rep.tasks if t.kind == "comm"}
+    assert "link:node" in links, links   # gradsync binds to the DCN stage
+    assert "link:model" in links, links  # tp_row's all-reduce rides ICI
+    gs = [t for t in rep.tasks if t.resource == "link:node"
+          and t.name.startswith("fc0:kernel:gradsync")]
+    assert gs, [t.name for t in rep.tasks if t.kind == "comm"]
+    w = m.get_layer_by_name("fc0").weight_specs["kernel"]
+    expect = cm.all_reduce_time(w.size_bytes, ("node", "data"), mach)
+    assert sum(t.duration for t in gs) == pytest.approx(expect, rel=1e-6)
