@@ -31,7 +31,7 @@ def main(argv=None):
         "--base-optimize-threshold", "--search-num-nodes",
         "--search-num-workers", "--import", "--export",
         "--substitution-json", "--machine-model-file", "--compute-dtype",
-        "--compgraph", "--profile-dir",
+        "--compgraph", "--profile-dir", "--strategy-cache-dir",
     }
     script = None
     i = 0
@@ -53,7 +53,14 @@ def main(argv=None):
     # expose to the script via flexflow_tpu.get_launch_config()
     import flexflow_tpu
 
-    flexflow_tpu._launch_config = FFConfig.parse_args(launcher_args)
+    # the launcher IS a real CLI invocation: honor FF_LAUNCH_ARGS (jupyter
+    # kernelspec / wrapper-injected machine config) here, with explicit
+    # launcher flags overriding it — parse_args itself only reads the env
+    # for argv=None so programmatic configs stay untouched
+    import shlex
+
+    env_args = shlex.split(os.environ.get("FF_LAUNCH_ARGS", ""))
+    flexflow_tpu._launch_config = FFConfig.parse_args(env_args + launcher_args)
     if os.environ.get("FLEXFLOW_PLATFORM"):
         import jax
 

@@ -161,6 +161,10 @@ class CompiledModel:
         self.cfg = model.config
         self._iteration = 0
         self.recompile_state = None  # set via recompile_on_condition
+        # strategy-cache event for THIS compile (hit/store), stamped by
+        # search/strategy_cache.py on the returned Strategy; None when the
+        # search didn't run (imported / data-parallel) or caching is off
+        self.search_cache_info = getattr(strategy, "_cache_info", None)
 
         self.forward_fn = build_forward(model.layers, model.input_tensors, outputs,
                                         mesh, strategy,
@@ -513,14 +517,36 @@ class CompiledModel:
                 return layout_match
         return cands[0]
 
+    def search_cache_stats(self) -> dict:
+        """Search fast-path observability: this compile's strategy-cache
+        event, the process-wide cache counters, the memoized-costing hit
+        rates, and the DP work counters (cache-stats of profile_report)."""
+        from flexflow_tpu.search import memo
+        from flexflow_tpu.search import strategy_cache as sc
+        from flexflow_tpu.search.dp import SEARCH_STATS
+
+        return {
+            "strategy_cache": dict(sc.STATS.as_dict(),
+                                   this_compile=self.search_cache_info),
+            "memo": memo.stats(),
+            "dp": dict(SEARCH_STATS),
+        }
+
     def profile_report(self, top: int = 0, print_table: bool = True):
         """Per-op timing table (reference: per-kernel ms prints behind
         --profiling, src/ops/kernels/linear_kernels.cu:98-117): each layer's
         analytic roofline prediction and isolated measured time under the
-        candidate matching its COMPILED sharding. Returns the rows."""
+        candidate matching its COMPILED sharding, plus the search fast-path
+        cache stats (strategy cache / memoized costing / DP counters).
+        Returns the rows."""
         from flexflow_tpu.search.measure import MeasuredCost
 
-        mc = MeasuredCost(self.machine, repeats=3, warmup=1)
+        # deliberately NOT backed by the persistent measured-cost store
+        # (cache_dir="" also overrides the FF_MEASURE_CACHE_DIR fallback):
+        # these quick repeats=3/warmup=1 numbers are report-quality, and
+        # persisting them would silently degrade the calibration data (and
+        # fingerprint) the measured SEARCH path relies on
+        mc = MeasuredCost(self.machine, repeats=3, warmup=1, cache_dir="")
         rows = []
         for layer in self.model.layers:
             cand = self._candidate_for(layer)
@@ -543,6 +569,19 @@ class CompiledModel:
                 print(f"{x['layer'][:28]:28} {x['op'][:18]:18} "
                       f"{x['analytic_us']:9.1f}u {x['measured_us']:9.1f}u "
                       f"{100 * x['measured_us'] / total:4.1f}%")
+            from flexflow_tpu.search import memo
+
+            stats = self.search_cache_stats()
+            cs, dp = stats["strategy_cache"], stats["dp"]
+            info = self.search_cache_info or {}
+            print(f"[strategy-cache] this_compile="
+                  f"{info.get('event', 'off/skipped')} "
+                  f"hits={cs['hits']} misses={cs['misses']} "
+                  f"stores={cs['stores']} invalidated={cs['invalidated']}")
+            print(f"[search] dp_calls={dp.get('calls', 0)} "
+                  f"expansions={dp.get('expansions', 0)} "
+                  f"prefix_skipped_layers={dp.get('layers_skipped', 0)}; "
+                  f"{memo.stats_line()}")
         return rows
 
     def export_sim_trace(self, path: str):

@@ -45,6 +45,11 @@ class FFConfig:
     export_strategy_file: str = ""
     memory_search: bool = False
     substitution_json: str = ""
+    # persistent strategy cache (search/strategy_cache.py): warm compile()
+    # of an unchanged (graph, machine, knobs, calibration) skips the search.
+    # dir "" -> $FF_STRATEGY_CACHE_DIR or ~/.cache/flexflow_tpu/strategy
+    strategy_cache: bool = True
+    strategy_cache_dir: str = ""
     # event-driven task-graph re-rank of the DP finalists (reference
     # LogicalTaskgraphBasedSimulator, simulator.h:785-827): "additive"
     # trusts the frontier DP's closed-form costing; "taskgraph" replays the
@@ -86,14 +91,16 @@ class FFConfig:
     def parse_args(argv: Optional[List[str]] = None) -> "FFConfig":
         # FF_LAUNCH_ARGS: machine config injected by the Jupyter kernelspec
         # (flexflow_tpu/jupyter — the reference custom-kernel analog) or a
-        # launcher wrapper; explicit argv/CLI flags override it
-        import shlex
-
-        env_args = shlex.split(os.environ.get("FF_LAUNCH_ARGS", ""))
-        if env_args:
+        # launcher wrapper. Honored ONLY for real CLI invocations
+        # (argv=None): a kernelspec-installed env var must not silently
+        # alter explicit programmatic configs in tests/scripts (ADVICE r5).
+        # CLI flags still override the environment.
+        if argv is None:
+            import shlex
             import sys
 
-            argv = env_args + list(sys.argv[1:] if argv is None else argv)
+            env_args = shlex.split(os.environ.get("FF_LAUNCH_ARGS", ""))
+            argv = env_args + list(sys.argv[1:])
         p = argparse.ArgumentParser("flexflow_tpu", allow_abbrev=False)
         p.add_argument("-e", "--epochs", type=int, default=1)
         p.add_argument("-b", "--batch-size", type=int, default=64)
@@ -119,6 +126,9 @@ class FFConfig:
         p.add_argument("--export", dest="export_file", type=str, default="")
         p.add_argument("--memory-search", action="store_true")
         p.add_argument("--substitution-json", type=str, default="")
+        p.add_argument("--strategy-cache", action=argparse.BooleanOptionalAction,
+                       default=True)
+        p.add_argument("--strategy-cache-dir", type=str, default="")
         p.add_argument("--simulator-mode", type=str, default="additive",
                        choices=("additive", "taskgraph"))
         p.add_argument("--simulator-segment-size", type=int,
@@ -164,6 +174,8 @@ class FFConfig:
             export_strategy_file=args.export_file,
             memory_search=args.memory_search,
             substitution_json=args.substitution_json,
+            strategy_cache=args.strategy_cache,
+            strategy_cache_dir=args.strategy_cache_dir,
             simulator_mode=args.simulator_mode,
             simulator_segment_size=args.simulator_segment_size,
             simulator_topk=args.simulator_topk,

@@ -21,6 +21,8 @@ def _freeze(v):
         return tuple(sorted((k, _freeze(x)) for k, x in v.items()))
     if isinstance(v, (list, tuple)):
         return tuple(_freeze(x) for x in v)
+    if hasattr(v, "tobytes") and hasattr(v, "shape"):  # ndarray constants
+        return (tuple(v.shape), str(getattr(v, "dtype", "")), v.tobytes())
     return v
 
 

@@ -7,7 +7,9 @@ flags (-ll:gpu, -ll:fsize, ...) BEFORE user code runs. The TPU runtime needs
 no process takeover — JAX initializes lazily — so the analog is a standard
 ipykernel kernelspec whose launch ENVIRONMENT carries the machine
 configuration: FF launch flags (mesh shape, search budget, ...) in
-`FF_LAUNCH_ARGS` (consumed by FFConfig.parse_args / the launcher), the
+`FF_LAUNCH_ARGS` (consumed by FFConfig.parse_args() with argv=None — real
+CLI/kernel invocations only, never explicit programmatic argv — and by the
+launcher), the
 platform pin in `FLEXFLOW_PLATFORM`, and XLA device-count flags for
 virtual-mesh notebooks.
 

@@ -14,6 +14,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import sys
 from typing import Optional
 
 from flexflow_tpu.jupyter import kernelspec, load_config
@@ -39,12 +40,12 @@ def install(config: Optional[str] = None, kernel_name: str = "flexflow_tpu",
             from jupyter_client.kernelspec import KernelSpecManager
 
             base = KernelSpecManager().user_kernel_dir if user else \
-                os.path.join(os.sys.prefix, "share", "jupyter", "kernels")
+                os.path.join(sys.prefix, "share", "jupyter", "kernels")
             kdir = os.path.join(base, kernel_name)
         except ImportError:
             base = os.path.join(os.path.expanduser("~"), ".local", "share",
                                 "jupyter", "kernels") if user else \
-                os.path.join(os.sys.prefix, "share", "jupyter", "kernels")
+                os.path.join(sys.prefix, "share", "jupyter", "kernels")
             kdir = os.path.join(base, kernel_name)
     os.makedirs(kdir, exist_ok=True)
     with open(os.path.join(kdir, "kernel.json"), "w") as f:

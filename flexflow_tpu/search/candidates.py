@@ -33,6 +33,7 @@ from flexflow_tpu.ops.registry import get_op_def, io_bytes
 from flexflow_tpu.parallel.machine import MachineSpec
 from flexflow_tpu.parallel.sharding import DimSharding
 from flexflow_tpu.search import cost_model as cm
+from flexflow_tpu.search import memo
 
 
 @dataclasses.dataclass
@@ -57,7 +58,32 @@ class Candidate:
     passthrough: bool = False
     drop_axis: Optional[str] = None
 
+    def memo_key(self) -> tuple:
+        """Hashable identity of this placement (tier-2 interning)."""
+        return (self.name,
+                tuple(memo.freeze_dims(d) for d in self.in_dims),
+                tuple(memo.freeze_dims(d) for d in self.out_dims),
+                tuple(sorted((w, memo.freeze_dims(d))
+                             for w, d in self.weight_dims.items())),
+                self.compute_degree, self.extra_comm, self.eff,
+                self.weight_stream_frac, self.passthrough, self.drop_axis)
+
     def op_time(self, layer: "Layer", machine: MachineSpec) -> float:
+        # interned by (op params key, placement, machine): structural twins
+        # (GPT-2 blocks, ResNeXt branches) and repeated DP frontier states
+        # share one evaluation. fork_join costs read layer.branches (not in
+        # params_key), so composites always take the direct path.
+        if memo.enabled() and not hasattr(layer, "branches"):
+            key = (layer.params_key(),
+                   memo.freeze_weight_specs(layer.weight_specs),
+                   self.memo_key(), memo.machine_fingerprint(machine))
+            t = memo.get("op_time", key)
+            if t is not memo.MISS:
+                return t
+            return memo.put("op_time", key, self._op_time(layer, machine))
+        return self._op_time(layer, machine)
+
+    def _op_time(self, layer: "Layer", machine: MachineSpec) -> float:
         od = get_op_def(layer.op_type)
         # per-device HBM traffic: activations divide by the compute degree,
         # weights stream in full per replica (each device reads its own shard)
@@ -168,6 +194,30 @@ def _best_groups(costs, n: int, b_local: int):
 def layer_candidates(layer: "Layer", machine: MachineSpec, batch_sizes,
                      enable_parameter: bool = True,
                      enable_attribute: bool = True) -> List[Candidate]:
+    """Candidate placements for one layer — interned by (op params key,
+    machine, knobs) so the substitution loop's repeated DP runs and
+    structural twins enumerate each op family once (search/memo.py, tier 2).
+    Candidates are immutable after construction; callers get a fresh list
+    over the shared objects. fork_join composites key on layer.branches
+    (absent from params_key), so they always rebuild."""
+    if memo.enabled() and layer.op_type is not OperatorType.FORK_JOIN:
+        key = (layer.params_key(),
+               memo.freeze_weight_specs(layer.weight_specs),
+               frozenset(batch_sizes), enable_parameter, enable_attribute,
+               memo.machine_fingerprint(machine))
+        cands = memo.get("candidates", key)
+        if cands is memo.MISS:
+            cands = memo.put("candidates", key, _layer_candidates(
+                layer, machine, batch_sizes, enable_parameter,
+                enable_attribute))
+        return list(cands)
+    return _layer_candidates(layer, machine, batch_sizes, enable_parameter,
+                             enable_attribute)
+
+
+def _layer_candidates(layer: "Layer", machine: MachineSpec, batch_sizes,
+                      enable_parameter: bool = True,
+                      enable_attribute: bool = True) -> List[Candidate]:
     t = layer.op_type
     ispecs = [x.spec for x in layer.inputs]
     ospecs = [o.spec for o in layer.outputs]
@@ -355,7 +405,16 @@ def layer_candidates(layer: "Layer", machine: MachineSpec, batch_sizes,
         stacked = congruent_branches(layer)
         b_local = (ispecs[0].shape[0] // max(1, _ddeg([dp_in[0][0]], machine))
                    if ispecs and ispecs[0].ndim else 1)
-        for m in maxes:
+        # ADVICE r5 crash gate: when the batch cannot shard over the batch
+        # axes (_dp_dims fell back to replicated — e.g. batch 6 on data=4),
+        # place_branches' backward fails at trace time (g_l varies over the
+        # batch axes while the replicated primals do not) and the grouped
+        # kernel raises outright — a searched inter:/grouped strategy would
+        # crash at compile. Mirror interop._batch_pspec's fallback: emit
+        # inter candidates only when the batch actually shards.
+        batch_shards = (not ispecs or not ispecs[0].ndim
+                        or dp_in[0][0] is not None)
+        for m in (maxes if batch_shards else ()):
             n = machine.mesh_axes[m]
             out_bytes = cm.shard_bytes(ospecs[0], dp_out[0], machine)
             if n == k and inter_placeable(layer):

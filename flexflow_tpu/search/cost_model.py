@@ -24,6 +24,7 @@ from typing import Dict, List, Optional, Sequence
 from flexflow_tpu.core.tensor import TensorSpec
 from flexflow_tpu.parallel.machine import MachineSpec
 from flexflow_tpu.parallel.sharding import DimSharding
+from flexflow_tpu.search import memo
 
 
 def _axes_of(d: DimSharding) -> tuple:
@@ -117,7 +118,23 @@ def overlapped_step_cost(comp: float, comm: float, machine: MachineSpec) -> floa
 def reshard_time(spec: TensorSpec, src: Sequence[DimSharding],
                  dst: Sequence[DimSharding], machine: MachineSpec) -> float:
     """Cost of moving a tensor from layout src to dst — the price of a
-    parallel op (Repartition/Combine/Replicate/AllToAll) on this machine."""
+    parallel op (Repartition/Combine/Replicate/AllToAll) on this machine.
+
+    Interned by (tensor geometry, src, dst, machine) — the DP's edge costs
+    are the hottest call in the search and structural twins re-price the
+    same transitions constantly (search/memo.py, tier 2)."""
+    if memo.enabled():
+        key = (spec.ndim, spec.size_bytes, memo.freeze_dims(src),
+               memo.freeze_dims(dst), memo.machine_fingerprint(machine))
+        t = memo.get("reshard", key)
+        if t is not memo.MISS:
+            return t
+        return memo.put("reshard", key, _reshard_time(spec, src, dst, machine))
+    return _reshard_time(spec, src, dst, machine)
+
+
+def _reshard_time(spec: TensorSpec, src: Sequence[DimSharding],
+                  dst: Sequence[DimSharding], machine: MachineSpec) -> float:
     nd = spec.ndim
     src = list(src or [None] * nd) + [None] * (nd - len(src or []))
     dst = list(dst or [None] * nd) + [None] * (nd - len(dst or []))
@@ -148,7 +165,24 @@ def grad_sync_time(weight_specs: Dict[str, TensorSpec],
                    weight_dims: Dict[str, List[DimSharding]],
                    machine: MachineSpec, batch_axes: Sequence[str]) -> float:
     """Gradient all-reduce over the replica axes of each weight (reference:
-    ncclAllReduce fused into the optimizer update, optimizer_kernel.cu:88)."""
+    ncclAllReduce fused into the optimizer update, optimizer_kernel.cu:88).
+    Interned by (weight geometry, layouts, machine) — see search/memo.py."""
+    if not weight_specs:
+        return 0.0
+    if memo.enabled():
+        key = (memo.freeze_weight_specs(weight_specs),
+               tuple(sorted((w, memo.freeze_dims(d))
+                            for w, d in weight_dims.items())),
+               tuple(batch_axes), memo.machine_fingerprint(machine))
+        t = memo.get("grad_sync", key)
+        if t is not memo.MISS:
+            return t
+        return memo.put("grad_sync", key, _grad_sync_time(
+            weight_specs, weight_dims, machine, batch_axes))
+    return _grad_sync_time(weight_specs, weight_dims, machine, batch_axes)
+
+
+def _grad_sync_time(weight_specs, weight_dims, machine, batch_axes) -> float:
     t = 0.0
     for w, spec in weight_specs.items():
         dims = weight_dims.get(w, [None] * spec.ndim)

@@ -24,19 +24,28 @@ from typing import Dict, List, Optional, Sequence, Tuple
 from flexflow_tpu.core.graph import topo_order
 from flexflow_tpu.parallel.machine import MachineSpec
 from flexflow_tpu.search import cost_model as cm
+from flexflow_tpu.search import memo
 from flexflow_tpu.search.candidates import Candidate, layer_candidates
 
+# Process-wide search instrumentation (the search fast path's observable):
+# calls = search_graph invocations, expansions = (beam entry x candidate)
+# inner-loop evaluations (the DP's unit of work — a strategy-cache hit must
+# leave this at 0), layers_skipped / prefix_hits = tier-3 prefix reuse.
+SEARCH_STATS: Dict[str, int] = {}
 
-def _freeze_dims(dims) -> Tuple:
-    out = []
-    for d in dims or ():
-        if d is None:
-            out.append(None)
-        elif isinstance(d, str):
-            out.append(d)
-        else:
-            out.append(tuple(d))
-    return tuple(out)
+
+def reset_search_stats() -> None:
+    SEARCH_STATS.update(calls=0, expansions=0, layers_skipped=0,
+                        prefix_hits=0, prefix_misses=0)
+
+
+reset_search_stats()
+
+
+# canonical None|str|tuple form per dim — ONE implementation, shared with
+# the memo/prefix-cache keys so layout canonicalization can never drift
+# between the DP's frontier keys and the tier-2/3 cache keys
+_freeze_dims = memo.freeze_dims
 
 
 def _drop_axis(d, ax):
@@ -67,14 +76,101 @@ class SearchResult:
     mem_bytes: int                 # predicted per-device memory high-water
 
 
+# ------------------------------------------------- tier-3 incremental DP
+class DPPrefixCache:
+    """Cross-graph reuse of DP beam states for the substitution loop.
+
+    After a GraphXfer rewrite, every layer BEFORE the rewrite site is
+    unchanged — but `search_graph` re-ran the whole frontier DP anyway.
+    This cache snapshots the (pruned) beam after each layer, keyed by a
+    canonical, name/guid-free identity of the graph prefix plus the set of
+    prefix tensors still live at that boundary; a later `search_graph` on a
+    rewritten clone resumes from the deepest matching snapshot and only
+    re-prices the affected frontier window (the analog of the reference's
+    memoized sequence-split sub-results, graph.cc:1586).
+
+    Correctness: two graphs share a snapshot iff (a) their prefix rows
+    (op/params/wiring/pins/weight specs + graph-input specs) are identical —
+    so per-layer candidates, edge costs and within-prefix liveness coincide
+    — and (b) the set of prefix tensors consumed at-or-after the boundary is
+    identical (frontier composition depends on suffix consumption). Beam
+    frontiers are stored under canonical tensor coordinates (producer topo
+    position, output slot) and remapped to the resuming graph's guids.
+
+    One instance is only valid for a fixed (machine, beam_width, mem_budget,
+    cost_fn, enable flags) — the substitution loop creates one per search.
+    """
+
+    def __init__(self, max_entries: int = 100_000):
+        self.snaps: Dict[Tuple, Dict] = {}
+        self.max_entries = max_entries
+
+    def get(self, key):
+        return self.snaps.get(key)
+
+    def put(self, key, beam):
+        if len(self.snaps) < self.max_entries:
+            self.snaps[key] = beam
+
+
+def _prefix_identity(layers, input_tensors, pins):
+    """Per-layer cumulative canonical keys + guid -> coordinate map. A
+    coordinate is ("in", input_idx) or (producer_topo_idx, output_slot).
+    Keys are rolling sha256 hexdigests of the canonical rows — O(row) per
+    layer and O(1) to hash/compare in the snapshot dict (a nested-tuple
+    chain would re-walk the whole prefix on every lookup)."""
+    import hashlib
+
+    from flexflow_tpu.search.pcg import _freeze as _freeze_params
+
+    coords: Dict[int, Tuple] = {
+        t.guid: ("in", i) for i, t in enumerate(input_tensors)}
+    h = hashlib.sha256(repr(tuple(
+        (t.spec.shape, t.spec.dtype) for t in input_tensors)).encode())
+    keys = []
+    for li, layer in enumerate(layers):
+        row = (layer.op_type.value, _freeze_params(layer.params),
+               tuple(coords.get(t.guid, ("?", t.guid)) for t in layer.inputs),
+               pins.get(layer.name) if pins else None,
+               memo.freeze_weight_specs(layer.weight_specs),
+               memo.branches_signature(layer))
+        h.update(repr(row).encode())
+        keys.append(h.hexdigest())  # digest-so-far: cumulative prefix id
+        for oi, o in enumerate(layer.outputs):
+            coords[o.guid] = (li, oi)
+    return keys, coords
+
+
+def _live_coords(li, n_layers, coords, last_use):
+    """Canonical coords of tensors in the DP frontier after layer li (the
+    exact rule the DP applies: produced at or before li, consumed after li —
+    plus the last layer's outputs, which the DP always keeps)."""
+    out = set()
+    for g, c in coords.items():
+        produced = -1 if c[0] == "in" else c[0]
+        if produced > li:
+            continue
+        if last_use.get(g, -1) > li or (li == n_layers - 1 and produced == li):
+            out.add(c)
+    return frozenset(out)
+
+
 def search_graph(model, machine: MachineSpec, beam_width: int = 64,
                  enable_parameter: bool = True, enable_attribute: bool = True,
                  mem_budget: Optional[float] = None,
                  cost_fn=None,
                  pins: Optional[Dict[str, str]] = None,
-                 topk: int = 1) -> "SearchResult | List[SearchResult]":
+                 topk: int = 1,
+                 prefix_cache: Optional[DPPrefixCache] = None,
+                 ) -> "SearchResult | List[SearchResult]":
     """cost_fn(layer, cand) -> seconds overrides the analytic op time
     (hook for the measured path, search/measure.py).
+
+    `prefix_cache` (tier-3 fast path) resumes the DP from the deepest beam
+    snapshot whose canonical graph prefix + boundary liveness match this
+    graph, re-pricing only the frontier window a rewrite touched. The
+    caller guarantees one cache instance per (machine, beam_width,
+    mem_budget, cost_fn, enable flags) combination.
 
     `model` is anything with .layers / .input_tensors (FFModel or a PCG).
     `pins` restricts named layers to one candidate (by candidate name) — the
@@ -89,6 +185,7 @@ def search_graph(model, machine: MachineSpec, beam_width: int = 64,
     and taskgraph mode degrades gracefully to the additive choice. Interior
     diversity (e.g. which layer to shard, the position-dependent-exposure
     case) is exercised through the MCMC taskgraph evaluator instead."""
+    SEARCH_STATS["calls"] = SEARCH_STATS.get("calls", 0) + 1
     layers = topo_order(model.layers)
     batch_sizes = {t.shape[0] for t in model.input_tensors if t.ndim > 0}
     mem_budget = mem_budget or machine.hbm_bytes
@@ -126,6 +223,37 @@ def search_graph(model, machine: MachineSpec, beam_width: int = 64,
         init_frontier: (0.0, 0, init_act, ())}
     cand_cache: Dict[str, List[Candidate]] = {}
 
+    # tier-3: resume from the deepest matching prefix snapshot
+    resume_li = -1
+    pc_keys = pc_coords = None
+    if prefix_cache is not None:
+        pc_keys, pc_coords = _prefix_identity(layers, model.input_tensors,
+                                              pins)
+        inv = {c: g for g, c in pc_coords.items()}
+        for li in range(len(layers) - 1, -1, -1):
+            live = _live_coords(li, len(layers), pc_coords, last_use)
+            snap = prefix_cache.get((pc_keys[li], live))
+            if snap is None:
+                continue
+            resumed = {}
+            for cf, entry in snap.items():
+                guids = [(inv.get(c), d) for c, d in cf]
+                if any(g is None for g, _ in guids):
+                    resumed = None
+                    break
+                resumed[tuple(sorted(guids))] = entry
+            if resumed:
+                beam = resumed
+                resume_li = li
+                SEARCH_STATS["prefix_hits"] = SEARCH_STATS.get(
+                    "prefix_hits", 0) + 1
+                SEARCH_STATS["layers_skipped"] = SEARCH_STATS.get(
+                    "layers_skipped", 0) + li + 1
+                break
+        else:
+            SEARCH_STATS["prefix_misses"] = SEARCH_STATS.get(
+                "prefix_misses", 0) + 1
+
     for li, layer in enumerate(layers):
         for o in layer.outputs:
             specs[o.guid] = o.spec
@@ -139,11 +267,15 @@ def search_graph(model, machine: MachineSpec, beam_width: int = 64,
                                f"{layer.name} (have {[c.name for c in cands]})")
             cands = sel
         cand_cache[layer.name] = cands
+        if li <= resume_li:
+            continue  # beam restored from snapshot; candidates only decode traces
         new_beam: Dict[Tuple, Tuple[float, int, int, Tuple]] = {}
         for frontier, (cost, w_mem, act_high, trace) in beam.items():
             fmap = dict(frontier)
             fmap_act = _live_act_bytes(fmap)
             for ci, cand in enumerate(cands):
+                SEARCH_STATS["expansions"] = SEARCH_STATS.get(
+                    "expansions", 0) + 1
                 c = cost
                 if cand.passthrough:
                     # identity layout marker: adopt input-0's layout (minus
@@ -218,6 +350,17 @@ def search_graph(model, machine: MachineSpec, beam_width: int = 64,
         beam = new_beam
         if not beam:
             raise RuntimeError(f"search dead-ended at layer {layer.name}")
+        if prefix_cache is not None:
+            # snapshot the pruned beam under canonical coordinates (store
+            # key carries the boundary liveness so only suffixes consuming
+            # the same prefix tensors resume from it)
+            live = _live_coords(li, len(layers), pc_coords, last_use)
+            # key=repr: coords mix ("in", i) and (topo_idx, slot) tuples,
+            # which plain tuple ordering cannot compare
+            snap = {tuple(sorted(((pc_coords[g], d) for g, d in f),
+                                 key=repr)): e
+                    for f, e in beam.items()}
+            prefix_cache.put((pc_keys[li], live), snap)
 
     def _to_result(entry) -> SearchResult:
         cost, wm, ah, trace = entry

@@ -17,8 +17,10 @@ model is the default and this path is opt-in calibration.
 
 from __future__ import annotations
 
+import json
+import os
 import time
-from typing import TYPE_CHECKING, Dict, Tuple
+from typing import TYPE_CHECKING, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -39,12 +41,75 @@ def _shard_shape(spec, dims, machine):
 
 
 class MeasuredCost:
-    def __init__(self, machine: MachineSpec, repeats: int = 5, warmup: int = 2):
+    def __init__(self, machine: MachineSpec, repeats: int = 5, warmup: int = 2,
+                 cache_dir: Optional[str] = None):
         self.machine = machine
         self.repeats = repeats
         self.warmup = warmup
         self.cache: Dict[Tuple, Tuple[float, float]] = {}
         self._floor: float = -1.0  # lazy: scalar-fetch RTT (tunnel latency)
+        # persistent (params_key, layout, machine) -> (fwd, bwd) store (the
+        # reference's measure_operator_cost cache made cross-process,
+        # simulator.cc:537-560): microbenchmarks are the expensive part of
+        # the measured path, so they outlive the process. One file per
+        # machine fingerprint; its content hash doubles as the strategy
+        # cache's calibration fingerprint (search/strategy_cache.py).
+        if cache_dir is None:
+            cache_dir = os.environ.get("FF_MEASURE_CACHE_DIR", "")
+        self.cache_path: Optional[str] = None
+        if cache_dir:
+            from flexflow_tpu.search import memo
+
+            self.cache_path = os.path.join(
+                os.path.expanduser(cache_dir),
+                f"measured-{memo.machine_fingerprint(machine)}.json")
+            self._load_disk()
+
+    def _load_disk(self):
+        # keys persist as repr() of the in-memory tuple key — enums, shapes
+        # and dtypes all repr canonically, so the string is process-stable
+        self._disk: Dict[str, list] = {}
+        self._dirty: Dict[str, list] = {}  # keys THIS process measured
+        self._disk_mtime = 0.0
+        try:
+            with open(self.cache_path) as f:
+                self._disk = dict(json.load(f))
+            self._disk_mtime = os.path.getmtime(self.cache_path)
+        except (OSError, ValueError):
+            pass
+
+    def _persist(self, key, val):
+        if not self.cache_path:
+            return
+        try:
+            os.makedirs(os.path.dirname(self.cache_path), exist_ok=True)
+            # merge-on-write: overlay ONLY the keys this process measured
+            # (the dirty set) onto a re-read of the file, so a concurrent
+            # measurer's fresher entries for other keys survive. The mtime
+            # gate skips the re-read when nobody else wrote, keeping
+            # per-measurement I/O at one O(n) dump.
+            self._dirty[repr(key)] = list(val)
+            try:
+                mtime = os.path.getmtime(self.cache_path)
+            except OSError:
+                mtime = 0.0
+            if mtime != self._disk_mtime:
+                try:
+                    with open(self.cache_path) as f:
+                        current = dict(json.load(f))
+                except (OSError, ValueError):
+                    current = {}
+                current.update(self._dirty)
+                self._disk = current
+            else:
+                self._disk[repr(key)] = list(val)
+            tmp = self.cache_path + f".tmp{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump(self._disk, f, indent=0, sort_keys=True)
+            os.replace(tmp, self.cache_path)
+            self._disk_mtime = os.path.getmtime(self.cache_path)
+        except OSError:
+            self.cache_path = None  # unwritable dir: degrade to in-memory
 
     def _fetch_floor(self) -> float:
         """The per-window cost of the synchronizing host fetch itself
@@ -78,8 +143,14 @@ class MeasuredCost:
                tuple(sorted((w, tuple(map(str, d))) for w, d in cand.weight_dims.items())))
         if key in self.cache:
             return self.cache[key]
+        if self.cache_path:
+            hit = self._disk.get(repr(key))
+            if hit is not None:
+                self.cache[key] = (float(hit[0]), float(hit[1]))
+                return self.cache[key]
         try:
             fwd, bwd = self._measure(layer, cand)
+            self._persist(key, (fwd, bwd))
         except Exception:
             # fall back to the analytic COMPUTE-ONLY time: cand.op_time
             # includes extra_comm + grad_sync, which op_time() below adds

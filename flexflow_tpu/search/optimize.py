@@ -39,19 +39,61 @@ def graph_optimize(model, machine: MachineSpec,
                    measured: bool = False) -> Strategy:
     """Unity search: graph substitutions (best-first under budget/alpha) over
     the frontier DP. Falls back to the plain DP when the engine is disabled
-    (enable_parameter_parallel=False etc. restricts candidates either way)."""
+    (enable_parameter_parallel=False etc. restricts candidates either way).
+
+    Fast path (search/strategy_cache.py): unless cfg.strategy_cache is off,
+    the winning Strategy is persisted keyed by (graph hash, machine
+    fingerprint, search knobs, calibration fingerprint) — a warm call on an
+    unchanged model returns the validated cached strategy without running
+    the substitution loop or a single DP expansion."""
+    import time
+
+    from flexflow_tpu.search import strategy_cache as sc
+
     cfg = model.config
+    use_cache = bool(getattr(cfg, "strategy_cache", True))
+    cache_dir = sc.resolve_dir(cfg) if use_cache else None
     cost_fn = None
+    measure_cache_path = None
     if measured or cfg.profiling:
         try:
             from flexflow_tpu.search.measure import MeasuredCost
 
-            cost_fn = MeasuredCost(machine).op_time
+            # the measured-cost store is its own fast-path tier: it keeps
+            # persisting under the resolved cache dir even when the
+            # STRATEGY cache is off (--no-strategy-cache asks for fresh
+            # searches, not for re-running every on-device microbenchmark)
+            mc = MeasuredCost(machine, cache_dir=sc.resolve_dir(cfg))
+            cost_fn = mc.op_time
+            measure_cache_path = mc.cache_path
         except Exception:
             cost_fn = None
+    if use_cache:
+        calib = sc.calibration_fingerprint(
+            measure_cache_path if cost_fn else None)
+        key = sc.cache_key(model, machine, cfg, calib)
+        cached = sc.lookup(cache_dir, key, model, machine)
+        if cached is not None:
+            return cached
     from flexflow_tpu.search.unity import unity_optimize
 
-    st, _stats = unity_optimize(model, machine, cost_fn=cost_fn)
+    t0 = time.perf_counter()
+    st, stats = unity_optimize(model, machine, cost_fn=cost_fn)
+    if use_cache:
+        if cost_fn is not None:
+            # the measured search wrote new microbenchmarks into the store
+            # it is fingerprinted by: re-key on the POST-search content so
+            # the next run's lookup (which hashes the populated store)
+            # finds this entry instead of orphaning it
+            calib = sc.calibration_fingerprint(measure_cache_path)
+            key = sc.cache_key(model, machine, cfg, calib)
+        sc.store(cache_dir, key, st, meta={
+            "cost_s": stats.best_cost,
+            "baseline_cost_s": stats.baseline_cost,
+            "expansions": stats.expansions,
+            "search_wallclock_s": time.perf_counter() - t0,
+            "calibration": calib,
+        })
     return st
 
 

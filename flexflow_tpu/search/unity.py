@@ -24,8 +24,15 @@ from flexflow_tpu.core.graph import topo_order
 from flexflow_tpu.ops.op_type import PARALLEL_OPS, OperatorType
 from flexflow_tpu.parallel.machine import MachineSpec
 from flexflow_tpu.parallel.sharding import OpSharding, Strategy
+from flexflow_tpu.search import memo
 from flexflow_tpu.search.candidates import _dp_dims, candidate_attrs
-from flexflow_tpu.search.dp import SearchResult, _drop_axis, _freeze_dims, search_graph
+from flexflow_tpu.search.dp import (
+    DPPrefixCache,
+    SearchResult,
+    _drop_axis,
+    _freeze_dims,
+    search_graph,
+)
 from flexflow_tpu.search.pcg import PCG
 from flexflow_tpu.search.substitution import (
     GraphXfer,
@@ -61,18 +68,25 @@ def substitution_optimize(pcg: PCG, machine: MachineSpec,
                           mem_budget: Optional[float] = None,
                           cost_fn=None,
                           enable_parameter: bool = True,
-                          enable_attribute: bool = True) -> Tuple[PCG, SearchResult, UnityStats]:
+                          enable_attribute: bool = True,
+                          dp_cache: Optional[DPPrefixCache] = None,
+                          ) -> Tuple[PCG, SearchResult, UnityStats]:
     """Best-first search over xfer applications (base_optimize analog).
 
     budget = max candidate-graph expansions; alpha prunes any graph costing
-    more than alpha * best (reference best-first pruning semantics)."""
+    more than alpha * best (reference best-first pruning semantics).
+    `dp_cache` (tier-3 fast path) shares DP beam snapshots across the
+    candidate graphs, so each rewrite only re-prices the frontier window it
+    touched — it must be dedicated to this (machine, knobs, cost_fn)."""
+    if dp_cache is None and memo.enabled():
+        dp_cache = DPPrefixCache()
 
     def cost(g: PCG) -> SearchResult:
         return search_graph(g, machine, beam_width=beam_width,
                             mem_budget=mem_budget, cost_fn=cost_fn,
                             enable_parameter=enable_parameter,
                             enable_attribute=enable_attribute,
-                            pins=g.pins)
+                            pins=g.pins, prefix_cache=dp_cache)
 
     r0 = cost(pcg)
     stats = UnityStats(baseline_cost=r0.cost, best_cost=r0.cost)
@@ -314,19 +328,28 @@ def unity_optimize(model, machine: MachineSpec, cost_fn=None) -> Tuple[Strategy,
     budget_left = max(8, cfg.search_budget)
     # seg key -> (rewrite path, baseline_cost, refined candidate names in
     # topo order once taskgraph refinement ran — replayed as pins — or None)
-    memo: Dict[Tuple, Tuple] = {}
+    seg_memo: Dict[Tuple, Tuple] = {}
     st = Strategy(mesh_axes=dict(machine.mesh_axes), name="unity")
     model_layer_names = {l.name for l in model.layers}
     model_input_names = {t.name for t in model.input_tensors}
     for t in model.input_tensors:
         batch_sizes = {x.shape[0] for x in model.input_tensors if x.ndim > 0}
         st.input_shardings[t.name] = _dp_dims(t.shape, machine, batch_sizes)
+    # one DP prefix cache for the whole optimize call (constant machine/
+    # knobs/cost_fn): segment replays and the substitution loop's candidate
+    # graphs all resume from shared beam snapshots (tier-3 fast path)
+    dp_cache = DPPrefixCache() if memo.enabled() else None
+    # event-replay finalists re-rank only when their DP cost changed: the
+    # replay is deterministic in (graph, additive cost), so an unchanged
+    # pair re-yields the previous pick (tier-3, the ISSUE's re-rank rule)
+    sim_cache: Dict[Tuple, SearchResult] = {}
 
     def _cost_pcg(g: PCG) -> SearchResult:
         return search_graph(g, machine, beam_width=beam_width,
                             mem_budget=mem_budget, cost_fn=cost_fn,
                             enable_parameter=en_param,
-                            enable_attribute=en_attr, pins=g.pins)
+                            enable_attribute=en_attr, pins=g.pins,
+                            prefix_cache=dp_cache)
 
     def _sim_refine(g: PCG, r: SearchResult) -> SearchResult:
         """simulator_mode='taskgraph': the additive DP prunes, the
@@ -335,6 +358,14 @@ def unity_optimize(model, machine: MachineSpec, cost_fn=None) -> Tuple[Strategy,
         winner's top layout finalists by simulated makespan."""
         if cfg.simulator_mode != "taskgraph" or cfg.simulator_topk < 2:
             return r
+        # layer names ride the key: PCG.key() is name-free, but the cached
+        # SearchResult's choices are name-addressed — an isomorphic twin
+        # segment must not adopt another segment's names
+        sim_key = (g.key(), tuple(l.name for l in topo_order(g.layers)),
+                   r.cost)
+        hit = sim_cache.get(sim_key)
+        if hit is not None:
+            return hit
         # one extra DP per SEGMENT (not per costed candidate graph) to
         # recover the ranked finalists — ~1/budget overhead, cheaper than
         # carrying topk lists for every graph the best-first loop prices
@@ -344,17 +375,19 @@ def unity_optimize(model, machine: MachineSpec, cost_fn=None) -> Tuple[Strategy,
                                  mem_budget=mem_budget, cost_fn=cost_fn,
                                  enable_parameter=en_param,
                                  enable_attribute=en_attr, pins=g.pins,
-                                 topk=cfg.simulator_topk)
+                                 topk=cfg.simulator_topk,
+                                 prefix_cache=dp_cache)
         picked, _reports = sim.rerank(
             g, machine, finalists, cost_fn=cost_fn,
             segment_bytes=cfg.simulator_segment_size)
+        sim_cache[sim_key] = picked
         return picked
 
     for si, (seg, k) in enumerate(zip(segments, keys)):
         best = best_r = None
         refined_done = False
-        if k in memo:
-            path, base_cost, rnames = memo[k]
+        if k in seg_memo:
+            path, base_cost, rnames = seg_memo[k]
             replayed = replay_path(seg, xfers, path)
             if replayed is not None:
                 try:
@@ -369,7 +402,8 @@ def unity_optimize(model, machine: MachineSpec, cost_fn=None) -> Tuple[Strategy,
                             replayed, machine, beam_width=beam_width,
                             mem_budget=mem_budget, cost_fn=cost_fn,
                             enable_parameter=en_param,
-                            enable_attribute=en_attr, pins=pins)
+                            enable_attribute=en_attr, pins=pins,
+                            prefix_cache=dp_cache)
                         best, refined_done = replayed, True
                     else:
                         best, best_r = replayed, _cost_pcg(replayed)
@@ -381,15 +415,16 @@ def unity_optimize(model, machine: MachineSpec, cost_fn=None) -> Tuple[Strategy,
                 stats_all.baseline_cost += base_cost
                 stats_all.best_cost += best_r.cost
         if best is None:
-            uniq_left = len(set(keys[si:]) - set(memo))
+            uniq_left = len(set(keys[si:]) - set(seg_memo))
             seg_budget = max(1, budget_left // max(1, uniq_left))
             best, best_r, stats = substitution_optimize(
                 seg, machine, xfers, budget=seg_budget,
                 alpha=cfg.search_alpha, beam_width=beam_width,
                 mem_budget=mem_budget, cost_fn=cost_fn,
-                enable_parameter=en_param, enable_attribute=en_attr)
+                enable_parameter=en_param, enable_attribute=en_attr,
+                dp_cache=dp_cache)
             budget_left = max(0, budget_left - stats.expansions)
-            memo[k] = (stats.best_path, stats.baseline_cost, None)
+            seg_memo[k] = (stats.best_path, stats.baseline_cost, None)
             stats_all.expansions += stats.expansions
             stats_all.generated += stats.generated
             stats_all.deduped += stats.deduped
@@ -403,8 +438,8 @@ def unity_optimize(model, machine: MachineSpec, cost_fn=None) -> Tuple[Strategy,
                 # the re-rank may pick a finalist whose additive cost differs
                 stats_all.best_cost += refined.cost - best_r.cost
                 best_r = refined
-            if cfg.simulator_mode == "taskgraph" and k in memo:
-                memo[k] = (memo[k][0], memo[k][1],
+            if cfg.simulator_mode == "taskgraph" and k in seg_memo:
+                seg_memo[k] = (seg_memo[k][0], seg_memo[k][1],
                            [best_r.choices[l.name].name
                             for l in topo_order(best.layers)])
         strategy_from_pcg(best, machine, best_r, model_layer_names,

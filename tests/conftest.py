@@ -20,6 +20,18 @@ import numpy as np
 import pytest
 
 
+@pytest.fixture(autouse=True, scope="session")
+def _hermetic_strategy_cache(tmp_path_factory):
+    """Point the persistent strategy cache (search/strategy_cache.py, on by
+    default) at a per-session temp dir: the suite must never read stale
+    strategies from — or write into — the user-global ~/.cache store, or a
+    cost-model change could be masked by a warm hit. Tests that exercise
+    the cache itself pass an explicit strategy_cache_dir (which wins)."""
+    os.environ["FF_STRATEGY_CACHE_DIR"] = str(
+        tmp_path_factory.mktemp("strategy_cache"))
+    yield
+
+
 @pytest.fixture(scope="session")
 def devices():
     devs = jax.devices()

@@ -60,15 +60,25 @@ def test_reference_config_vocabulary(tmp_path):
 
 
 def test_ff_launch_args_env(monkeypatch):
-    """FFConfig.parse_args absorbs the kernel's FF_LAUNCH_ARGS; explicit
-    argv flags override the environment."""
+    """FFConfig.parse_args absorbs the kernel's FF_LAUNCH_ARGS only on real
+    CLI invocations (argv=None); CLI flags override the environment, and an
+    explicit programmatic argv is never silently altered by the env
+    (ADVICE r5: a kernelspec-installed env var must not leak into
+    tests/scripts that pass their own argv)."""
+    import sys
+
     monkeypatch.setenv("FF_LAUNCH_ARGS", "--mesh data=2,model=4 -b 32")
-    c = FFConfig.parse_args([])
+    monkeypatch.setattr(sys, "argv", ["prog"])
+    c = FFConfig.parse_args()
     assert c.mesh_shape == {"data": 2, "model": 4}
     assert c.batch_size == 32
-    c2 = FFConfig.parse_args(["-b", "64"])
+    monkeypatch.setattr(sys, "argv", ["prog", "-b", "64"])
+    c2 = FFConfig.parse_args()
     assert c2.batch_size == 64  # CLI wins
     assert c2.mesh_shape == {"data": 2, "model": 4}
+    # explicit programmatic argv: the env must NOT merge in
+    c3 = FFConfig.parse_args([])
+    assert c3.mesh_shape == {} and c3.batch_size == 64  # pure defaults
 
 
 def test_kernelspec_body():
