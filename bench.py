@@ -428,14 +428,28 @@ def main():
     }))
 
 
+_TRANSIENT_MARKERS = ("remote_compile", "read body", "UNAVAILABLE",
+                      "Connection reset", "Socket closed")
+
+
 def _is_transient_tunnel_error(e: BaseException) -> bool:
     """The axon tunnel occasionally drops a remote_compile / data stream
     mid-flight (observed r5: 'read body: response body closed before all
-    bytes were read'); the next attempt usually succeeds."""
+    bytes were read'); the next attempt usually succeeds.
+
+    Narrowed (ADVICE r5): the substring probe alone no longer retries —
+    the exception must ALSO be a type the tunnel client can raise:
+    RuntimeError (jaxlib's XlaRuntimeError subclasses it, and the client
+    wraps stream drops in bare RuntimeErrors — the observed r5 case),
+    OSError (ConnectionError/TimeoutError/socket errors), or a type
+    defined in a tunnel-adjacent package (grpc.RpcError etc.). An
+    unrelated ValueError('...UNAVAILABLE') from workload code no longer
+    reruns main() from scratch."""
     s = f"{type(e).__name__}: {e}"
-    return any(m in s for m in ("remote_compile", "read body",
-                                "UNAVAILABLE", "Connection reset",
-                                "Socket closed"))
+    transient = any(m in s for m in _TRANSIENT_MARKERS)
+    mod = (type(e).__module__ or "").split(".")[0]
+    return transient and (isinstance(e, (RuntimeError, OSError))
+                          or mod in ("jax", "jaxlib", "grpc", "axon"))
 
 
 if __name__ == "__main__":

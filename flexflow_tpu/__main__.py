@@ -32,6 +32,9 @@ def main(argv=None):
         "--search-num-workers", "--import", "--export",
         "--substitution-json", "--machine-model-file", "--compute-dtype",
         "--compgraph", "--profile-dir", "--strategy-cache-dir",
+        "--seq-length", "--simulator-mode", "--simulator-segment-size",
+        "--simulator-topk", "--simulator-trace",
+        "--sync-every", "--steps-per-dispatch", "--dispatch-ahead",
     }
     script = None
     i = 0

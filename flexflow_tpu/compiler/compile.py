@@ -37,7 +37,8 @@ from flexflow_tpu.optimizers import Optimizer, SGDOptimizer
 from flexflow_tpu.parallel.default_strategy import data_parallel_strategy
 from flexflow_tpu.parallel.machine import MachineSpec, build_mesh
 from flexflow_tpu.parallel.sharding import Strategy
-from flexflow_tpu.runtime.dataloader import SingleDataLoader, prefetch_to_device
+from flexflow_tpu.runtime.dataloader import (SingleDataLoader, prefetch_multi,
+                                             prefetch_to_device)
 
 
 def _search_machine(cfg, machine: MachineSpec) -> MachineSpec:
@@ -165,6 +166,9 @@ class CompiledModel:
         # search/strategy_cache.py on the returned Strategy; None when the
         # search didn't run (imported / data-parallel) or caching is off
         self.search_cache_info = getattr(strategy, "_cache_info", None)
+        # async-pipeline observability, rewritten by each fit (_fit_epochs):
+        # dispatches / host_syncs / barriers / fused_steps
+        self.step_stats: Dict[str, int] = {}
 
         self.forward_fn = build_forward(model.layers, model.input_tensors, outputs,
                                         mesh, strategy,
@@ -324,6 +328,15 @@ class CompiledModel:
         self.infer_step = jax.jit(_wrap(infer))
         self._train_step_fn = train_step  # unjitted body for make_multi_step
         self._wrap_precision = _wrap
+        self._multi_cache = {}  # steps_per_dispatch -> jitted multi-step
+
+    def _get_multi(self, k: int):
+        """Cached make_multi_step(k) — one jit per fused width per compile
+        (cleared by _build_steps on recompile)."""
+        fn = self._multi_cache.get(k)
+        if fn is None:
+            fn = self._multi_cache[k] = self.make_multi_step(k)
+        return fn
 
     def make_multi_step(self, n: int, donate: "Optional[bool]" = None):
         """One-dispatch n-step training: fori_loop over n stacked batches
@@ -335,8 +348,13 @@ class CompiledModel:
         tunnel's ~ms per dispatch).
 
         Returns jitted fn(params, opt_state, state, stacked_inputs,
-        stacked_labels, rng) -> (params, opt_state, state, mean_loss,
-        last_metrics); stacked arrays carry a leading n dim.
+        stacked_labels, rng, i0=0) -> (params, opt_state, state, mean_loss,
+        mean_metrics); stacked arrays carry a leading n dim. `i0` is the
+        global iteration of the first fused step: step i uses
+        fold_in(rng, i0 + i), so with rng = fit's base key the fused loop
+        consumes the SAME dropout/rng stream as n individually dispatched
+        train_steps at iterations i0..i0+n-1 (pass i0 as a jnp scalar to
+        avoid retracing per value).
 
         `donate=None` follows cfg.donate_state. CAUTION (same contract as
         train_step): under donation the INPUT params/opt_state/state
@@ -350,26 +368,28 @@ class CompiledModel:
             donate = self.cfg.donate_state
         step = self._train_step_fn
 
-        def multi(params, opt_state, state, inputs, labels, rng):
+        def multi(params, opt_state, state, inputs, labels, rng, i0=0):
             def at(i, arrs):
                 return [jax.lax.dynamic_index_in_dim(a, i, keepdims=False)
                         for a in arrs]
 
             def body(i, carry):
-                p, o, s, loss_sum, _ = carry
+                p, o, s, loss_sum, msum = carry
                 p, o, s, loss, mv = step(
                     p, o, s, at(i, inputs),
                     jax.lax.dynamic_index_in_dim(labels, i, keepdims=False),
-                    jax.random.fold_in(rng, i))
-                return (p, o, s, loss_sum + loss, mv)
+                    jax.random.fold_in(rng, i0 + i))
+                return (p, o, s, loss_sum + loss,
+                        jax.tree_util.tree_map(jnp.add, msum, mv))
 
             # step 0 outside the loop fixes the carry's loss/metric shapes
             p, o, s, l0, mv0 = step(params, opt_state, state,
                                     [a[0] for a in inputs], labels[0],
-                                    jax.random.fold_in(rng, 0))
-            p, o, s, lsum, mv = jax.lax.fori_loop(
+                                    jax.random.fold_in(rng, i0))
+            p, o, s, lsum, msum = jax.lax.fori_loop(
                 1, n, body, (p, o, s, l0, mv0))
-            return p, o, s, lsum / n, mv
+            return p, o, s, lsum / n, \
+                jax.tree_util.tree_map(lambda x: x / n, msum)
 
         return jax.jit(self._wrap_precision(multi),
                        donate_argnums=(0, 1, 2) if donate else ())
@@ -386,7 +406,20 @@ class CompiledModel:
 
     # ------------------------------------------------------------- training
     def fit(self, x, y, batch_size: Optional[int] = None, epochs: Optional[int] = None,
-            callbacks=None, verbose: bool = True):
+            callbacks=None, verbose: bool = True,
+            sync_every: Optional[int] = None,
+            steps_per_dispatch: Optional[int] = None):
+        # per-call overrides of the async-pipeline knobs (see config.py);
+        # None = the config's value, threaded through (cfg never mutated)
+        if sync_every is None:
+            sync_every = self.cfg.sync_every
+        if steps_per_dispatch is None:
+            steps_per_dispatch = self.cfg.steps_per_dispatch
+        return self._fit(x, y, batch_size, epochs, callbacks, verbose,
+                         sync_every, steps_per_dispatch)
+
+    def _fit(self, x, y, batch_size, epochs, callbacks, verbose,
+             sync_every, steps_per_dispatch):
         xs = x if isinstance(x, (list, tuple)) else [x]
         batch_size = batch_size or self.cfg.batch_size
         epochs = epochs or self.cfg.epochs
@@ -410,7 +443,9 @@ class CompiledModel:
             prof_ctx.__enter__()
         try:
             history = self._fit_epochs(epochs, loader, in_sh, lab_sh,
-                                       base_rng, batch_size, callbacks, verbose)
+                                       base_rng, batch_size, callbacks,
+                                       verbose, sync_every,
+                                       steps_per_dispatch)
         finally:
             if prof_ctx is not None:
                 prof_ctx.__exit__(None, None, None)
@@ -424,30 +459,109 @@ class CompiledModel:
         return history
 
     def _fit_epochs(self, epochs, loader, in_sh, lab_sh, base_rng,
-                    batch_size, callbacks, verbose):
+                    batch_size, callbacks, verbose, sync_every,
+                    steps_per_dispatch):
+        """Asynchronous training pipeline (the Legion async-launch analog):
+        the host's only per-step work is folding the rng key and issuing
+        the next dispatch — loss/metrics stay device-resident (deferred
+        PerfMetrics + a pending-loss list) and are materialized every
+        cfg.sync_every steps (0 = epoch end only), K=cfg.steps_per_dispatch
+        consecutive steps fuse into one make_multi_step dispatch over
+        stacked prefetched batches, and a block_until_ready barrier every
+        cfg.dispatch_ahead dispatches bounds how far the host may queue
+        ahead of the device. Per-batch callbacks (`on_batch_end`) or a
+        recompile trigger need per-step host control: they force K=1 and
+        per-step materialization (the synchronous loop).
+
+        `self.step_stats` counts dispatches / host_syncs / barriers /
+        fused_steps for the whole fit; each epoch's history entry carries
+        its own dispatches/host_syncs (tools/bench_step.py --check asserts
+        dispatches <= ceil(num_batches/K) and zero mid-epoch host syncs in
+        the default config)."""
         history = []
+        per_batch_cbs = [cb for cb in callbacks or []
+                         if hasattr(cb, "on_batch_end")]
+        ahead = max(1, int(self.cfg.dispatch_ahead))
+        in_sh_k = [NamedSharding(self.mesh, PartitionSpec(None, *s.spec))
+                   for s in in_sh]
+        lab_sh_k = NamedSharding(self.mesh,
+                                 PartitionSpec(None, *lab_sh.spec))
+        stats = self.step_stats = {"dispatches": 0, "host_syncs": 0,
+                                   "barriers": 0, "fused_steps": 0}
         for epoch in range(epochs):
+            # fallbacks re-evaluated per epoch: a recompile trigger
+            # registered mid-fit (e.g. by on_epoch_end) must drop the loop
+            # to 1-step dispatch — and _get_multi must be re-fetched after
+            # any recompile rebuilt the step functions
+            k = max(1, int(steps_per_dispatch))
+            sync = max(0, int(sync_every))
+            if per_batch_cbs or self.recompile_state is not None:
+                k, sync = 1, 1  # per-step host control required
+            multi = self._get_multi(k) if k > 1 else None
             pm = PerfMetrics()
             t0 = time.perf_counter()
-            loss_sum, nb = 0.0, 0
-            for dx, dy in prefetch_to_device(loader.epoch(), in_sh, lab_sh,
-                                             put=self._put):
-                rng = jax.random.fold_in(base_rng, self._iteration)
-                self.params, self.opt_state, self.state, loss, mvals = self.train_step(
-                    self.params, self.opt_state, self.state, dx, dy, rng)
-                self._iteration += 1
-                loss_sum += float(loss)
-                nb += 1
-                pm.update(batch_size, {k: float(v) for k, v in mvals.items()})
-                self._maybe_recompile()
+            # loss rides a second deferred PerfMetrics keyed by STEPS (not
+            # samples): device chunk-folding bounds memory on long epochs.
+            # Parity with the old `loss_sum += float(loss)` loop is
+            # bit-exact below fold_after pending steps, ~1e-7 relative
+            # beyond (see PerfMetrics docstring)
+            pml = PerfMetrics()
+            nb = 0
+            ep_disp = ep_sync = 0
+            since_sync = 0
+            for kind, dx, dy in prefetch_multi(
+                    loader.epoch(), k, in_sh, lab_sh, in_sh_k, lab_sh_k,
+                    put=self._put):
+                if kind == "k":
+                    (self.params, self.opt_state, self.state, loss,
+                     mvals) = multi(self.params, self.opt_state, self.state,
+                                    dx, dy, base_rng,
+                                    jnp.int32(self._iteration))
+                    steps = k
+                    stats["fused_steps"] += k
+                else:  # single step (k==1, or the tail of a fused epoch)
+                    rng = jax.random.fold_in(base_rng, self._iteration)
+                    (self.params, self.opt_state, self.state, loss,
+                     mvals) = self.train_step(self.params, self.opt_state,
+                                              self.state, dx, dy, rng)
+                    steps = 1
+                self._iteration += steps
+                nb += steps
+                since_sync += steps
+                ep_disp += 1
+                stats["dispatches"] += 1
+                pml.update_deferred(steps, {"loss": loss})
+                pm.update_deferred(batch_size * steps, mvals)
+                if sync and since_sync >= sync:
+                    pml.materialize()
+                    pm.materialize()
+                    stats["host_syncs"] += 1
+                    ep_sync += 1
+                    since_sync = 0
+                elif ep_disp % ahead == 0:
+                    # bounded dispatch-ahead: wait for the device to catch
+                    # up (no host transfer, just a queue-depth barrier)
+                    jax.block_until_ready(loss)
+                    stats["barriers"] += 1
+                for cb in per_batch_cbs:
+                    cb.on_batch_end(self._iteration, {"loss": float(loss)})
+                if kind == "1":
+                    self._maybe_recompile()
+            # epoch end: the one unavoidable materialization (not counted
+            # as a mid-epoch host sync)
+            pml.materialize()
             dt = time.perf_counter() - t0
             summ = pm.summary()
-            summ["loss"] = loss_sum / max(1, nb)
+            summ["loss"] = pml.sums.get("loss", 0.0) / max(1, nb)
             summ["epoch_time_s"] = dt
             summ["samples_per_sec"] = pm.train_all / dt if dt > 0 else 0.0
+            summ["dispatches"] = float(ep_disp)
+            summ["host_syncs"] = float(ep_sync)
             history.append(summ)
             if verbose:
-                ms = " ".join(f"{k}={v:.4f}" for k, v in summ.items() if k != "samples")
+                ms = " ".join(f"{k_}={v:.4f}" for k_, v in summ.items()
+                              if k_ not in ("samples", "dispatches",
+                                            "host_syncs"))
                 print(f"[epoch {epoch}] {ms}")
             for cb in callbacks or []:
                 if hasattr(cb, "on_epoch_end"):
@@ -464,15 +578,20 @@ class CompiledModel:
         in_sh = [self.input_sharding(t) for t in self.model.input_tensors]
         lab_sh = self.label_sharding((batch_size,) + tuple(np.asarray(y).shape[1:]))
         pm = PerfMetrics()
-        total_loss, nb = 0.0, 0
+        pml = PerfMetrics()  # deferred per-batch losses (chunk-folded)
+        ahead = max(1, int(self.cfg.dispatch_ahead))
+        nb = 0
         for dx, dy in prefetch_to_device(loader.epoch(), in_sh, lab_sh,
                                          put=self._put):
             loss, mvals = self.eval_step(self.params, self.state, dx, dy)
-            pm.update(batch_size, {k: float(v) for k, v in mvals.items()})
-            total_loss += float(loss)
+            pm.update_deferred(batch_size, mvals)
+            pml.update_deferred(1, {"loss": loss})
             nb += 1
+            if nb % ahead == 0:  # bounded dispatch-ahead, as in fit
+                jax.block_until_ready(loss)
+        pml.materialize()
         out = pm.summary()
-        out["loss"] = total_loss / max(1, nb)
+        out["loss"] = pml.sums.get("loss", 0.0) / max(1, nb)
         return out
 
     def forward(self, *inputs):
@@ -619,12 +738,27 @@ class CompiledModel:
             self._build_steps()
 
     # ----------------------------------------------------------- checkpoint
-    def save_checkpoint(self, path: str) -> str:
+    def save_checkpoint(self, path: str, block: Optional[bool] = None) -> str:
         """Full training-state checkpoint (params + optimizer state + BN
-        state + iteration) — orbax-backed; see runtime/checkpoint.py."""
+        state + iteration) — orbax-backed; see runtime/checkpoint.py.
+
+        With cfg.async_checkpoint (the default), the device→host snapshot
+        happens here (donation-safe) and serialization + fsync run on a
+        background writer thread, so periodic saves don't stall the step
+        loop. `load_checkpoint`/`wait_checkpoints` join pending writes;
+        pass block=True to force the old fully synchronous save."""
         from flexflow_tpu.runtime.checkpoint import save_checkpoint
 
-        return save_checkpoint(self, path)
+        if block is None:
+            block = not self.cfg.async_checkpoint
+        return save_checkpoint(self, path, block=block)
+
+    def wait_checkpoints(self) -> None:
+        """Join any in-flight async checkpoint writes (surfacing their
+        errors here rather than losing them with the writer thread)."""
+        from flexflow_tpu.runtime.checkpoint import wait_pending
+
+        wait_pending()
 
     def load_checkpoint(self, path: str) -> None:
         from flexflow_tpu.runtime.checkpoint import restore_checkpoint

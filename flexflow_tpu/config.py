@@ -59,6 +59,25 @@ class FFConfig:
     simulator_topk: int = 4
     # machine model (cost model) description file; "" = default v5p-like model
     machine_model_file: str = ""
+    # training-loop pipeline (compiler/compile.py _fit_epochs): the fit loop
+    # dispatches ahead of the device and never round-trips per step.
+    #   sync_every N>0 — materialize deferred loss/metrics to host every N
+    #     steps (live metrics at the cost of a host sync); 0 = epoch end
+    #     only (default: ZERO per-step host transfers). 1 reproduces the
+    #     old fully synchronous loop.
+    #   steps_per_dispatch K>1 — drive make_multi_step: K steps fused into
+    #     one dispatch (lax.fori_loop over stacked prefetched batches);
+    #     falls back to 1 when per-batch callbacks or a recompile trigger
+    #     need per-step host control.
+    #   dispatch_ahead — block_until_ready barrier every N dispatches so
+    #     the host can't queue unboundedly ahead of the device.
+    sync_every: int = 0
+    steps_per_dispatch: int = 1
+    dispatch_ahead: int = 32
+    # non-blocking checkpointing (runtime/checkpoint.py): params snapshot to
+    # host on the caller thread (donation-safe), serialization + fsync on a
+    # background writer thread; restore/exit wait for pending writes
+    async_checkpoint: bool = True
     # execution
     enable_fusion: bool = True
     profiling: bool = False
@@ -136,6 +155,11 @@ class FFConfig:
         p.add_argument("--simulator-topk", type=int, default=4)
         p.add_argument("--simulator-trace", type=str, default="")
         p.add_argument("--machine-model-file", type=str, default="")
+        p.add_argument("--sync-every", type=int, default=0)
+        p.add_argument("--steps-per-dispatch", type=int, default=1)
+        p.add_argument("--dispatch-ahead", type=int, default=32)
+        p.add_argument("--async-checkpoint", action=argparse.BooleanOptionalAction,
+                       default=True)
         p.add_argument("--fusion", dest="fusion", action="store_true", default=True)
         p.add_argument("--no-fusion", dest="fusion", action="store_false")
         p.add_argument("--profiling", action="store_true")
@@ -181,6 +205,10 @@ class FFConfig:
             simulator_topk=args.simulator_topk,
             simulator_trace=args.simulator_trace,
             machine_model_file=args.machine_model_file,
+            sync_every=args.sync_every,
+            steps_per_dispatch=args.steps_per_dispatch,
+            dispatch_ahead=args.dispatch_ahead,
+            async_checkpoint=args.async_checkpoint,
             enable_fusion=args.fusion,
             profiling=args.profiling,
             profile_dir=args.profile_dir,

@@ -462,9 +462,23 @@ class FFModel:
 
     # ------------------------------------------------------------ training
     def fit(self, x, y, batch_size: Optional[int] = None, epochs: Optional[int] = None,
-            callbacks=None, verbose: bool = True):
+            callbacks=None, verbose: bool = True,
+            sync_every: Optional[int] = None,
+            steps_per_dispatch: Optional[int] = None):
+        """Train. sync_every/steps_per_dispatch override the config's
+        async-pipeline knobs for this call (see FFConfig)."""
         return self.compiled.fit(x, y, batch_size=batch_size, epochs=epochs,
-                                 callbacks=callbacks, verbose=verbose)
+                                 callbacks=callbacks, verbose=verbose,
+                                 sync_every=sync_every,
+                                 steps_per_dispatch=steps_per_dispatch)
+
+    def save_checkpoint(self, path: str, block: Optional[bool] = None) -> str:
+        """Full-state checkpoint (async by default — cfg.async_checkpoint);
+        see CompiledModel.save_checkpoint."""
+        return self.compiled.save_checkpoint(path, block=block)
+
+    def load_checkpoint(self, path: str) -> None:
+        self.compiled.load_checkpoint(path)
 
     def forward(self, *inputs):
         return self.compiled.forward(*inputs)

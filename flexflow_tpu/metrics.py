@@ -34,21 +34,89 @@ class MetricsType(enum.Enum):
 
 @dataclasses.dataclass
 class PerfMetrics:
-    """Accumulated training metrics (reference: include/flexflow/perf_metrics.h)."""
+    """Accumulated training metrics (reference: include/flexflow/perf_metrics.h).
+
+    Two accumulation modes:
+      - `update(batch, {name: float})` — host floats, accumulated eagerly
+        (forces a device→host transfer per value at the call site).
+      - `update_deferred(batch, {name: jax.Array})` — DEVICE scalars queued
+        without materialization; nothing blocks until `materialize()` (called
+        by `summary()`), so the training loop's dispatch pipeline never
+        stalls on metrics. The reference analog is the per-shard metric
+        futures reduced lazily into PerfMetrics instead of eagerly pulled.
+
+    To bound memory, every `fold_after` queued updates are folded on-device
+    into ONE chunk scalar per metric (dispatch-only additions, no sync);
+    materialize then sums chunk scalars + the un-folded tail on host in
+    float64. Accumulation is bit-identical to the synchronous loop while
+    fewer than `fold_after` updates are pending between materializations
+    (always true for sync_every=1); past that, a chunk's internal device
+    float32 additions reassociate (~1e-7 relative) — the cross-chunk and
+    tail sums stay float64, so error does not grow with epoch length.
+    """
 
     train_all: int = 0
     sums: Dict[str, float] = dataclasses.field(default_factory=dict)
+    fold_after: int = 256
+    _pending: List = dataclasses.field(default_factory=list, repr=False)
+    _dev_chunks: Dict[str, List] = dataclasses.field(
+        default_factory=dict, repr=False)
 
     def update(self, batch: int, values: Dict[str, float]):
         self.train_all += batch
         for k, v in values.items():
             self.sums[k] = self.sums.get(k, 0.0) + v * batch
 
+    def update_deferred(self, batch: int, values: Dict[str, "jax.Array"]):
+        """Queue device scalars; no host transfer happens here."""
+        self.train_all += batch
+        if values:
+            self._pending.append((batch, dict(values)))
+            if len(self._pending) >= self.fold_after:
+                self._fold_on_device()
+
+    @property
+    def pending_updates(self) -> int:
+        return len(self._pending)
+
+    def _fold_on_device(self):
+        # fold the pending queue into one device chunk-scalar per metric
+        # (device-side adds only — async dispatches, no blocking); chunks
+        # are summed across in float64 at materialize time
+        chunk: Dict[str, "jax.Array"] = {}
+        for batch, values in self._pending:
+            for k, v in values.items():
+                term = v * jnp.float32(batch)
+                chunk[k] = term if k not in chunk else chunk[k] + term
+        for k, v in chunk.items():
+            self._dev_chunks.setdefault(k, []).append(v)
+        self._pending.clear()
+
+    def materialize(self) -> bool:
+        """Drain deferred updates into host `sums`. The ONLY place deferred
+        mode touches the host; returns True if anything was pending (the
+        fit loop's host-sync counter keys off this)."""
+        had = bool(self._pending) or bool(self._dev_chunks)
+        # chronological: folded chunks first (they predate the tail), then
+        # the un-folded tail — host float64 accumulation matching the
+        # synchronous loop's `sums[k] += float(v) * batch` term order
+        for k, chunks in self._dev_chunks.items():
+            for v in chunks:
+                self.sums[k] = self.sums.get(k, 0.0) + float(v)
+        self._dev_chunks.clear()
+        for batch, values in self._pending:
+            for k, v in values.items():
+                self.sums[k] = self.sums.get(k, 0.0) + float(v) * batch
+        self._pending.clear()
+        return had
+
     @property
     def train_correct(self) -> int:
+        self.materialize()
         return int(self.sums.get("accuracy", 0.0))
 
     def summary(self) -> Dict[str, float]:
+        self.materialize()
         n = max(1, self.train_all)
         out = {"samples": float(self.train_all)}
         for k, v in self.sums.items():

@@ -7,13 +7,27 @@ set_weight, Strategy.save/load) and adds what the survey prescribes: real
 orbax-backed checkpointing of params + optimizer state + non-trainable
 state + iteration counter, restored INTO the compiled shardings (orbax
 writes per-shard; multi-process runs coordinate through it natively).
+
+Non-blocking saves (copy-then-write): `save_checkpoint(..., block=False)`
+copies the trees to host ON THE CALLER THREAD — mandatory for correctness
+under donation (donate_state=True consumes the live params/opt_state
+buffers at the very next train_step, so a background thread must never
+read them) — then hands the host tree to a daemon writer thread that does
+the expensive part (orbax serialization, json/npz, fsync). The step loop
+only pays for the D2H copy. `wait_pending()` joins writers and re-raises
+their errors; `restore_checkpoint` waits for any in-flight write to the
+same directory, and saves to a directory with an in-flight write queue
+behind it (never two writers interleaving on one path).
 """
 
 from __future__ import annotations
 
+import atexit
 import json
+import logging
 import os
-from typing import Any, Dict, Optional
+import threading
+from typing import Any, Dict, List, Optional
 
 import jax
 import numpy as np
@@ -23,28 +37,151 @@ def _ckpt_dir(path: str) -> str:
     return os.path.abspath(path)
 
 
-def save_checkpoint(cm, path: str) -> str:
-    """Persist a CompiledModel's full training state (params, optimizer
-    state, BN/running state, iteration, strategy) under `path`."""
-    import orbax.checkpoint as ocp
+# ------------------------------------------------------- async write registry
+_PENDING: Dict[str, "_AsyncSave"] = {}
+_PENDING_LOCK = threading.Lock()
 
-    path = _ckpt_dir(path)
-    ckptr = ocp.StandardCheckpointer()
-    tree = {"params": cm.params, "opt_state": cm.opt_state}
+
+_EXIT_HOOKED = False
+
+
+def _wait_pending_at_exit():
+    # writer threads are daemons: without this join, a save issued just
+    # before interpreter exit would be killed mid-serialize and leave a
+    # silently truncated checkpoint directory
+    try:
+        wait_pending()
+    except Exception as e:
+        logging.getLogger("flexflow_tpu").error(
+            "async checkpoint write failed at exit: %s", e)
+
+
+def _register_exit_drain():
+    """Install the exit drain at FIRST async save. threading._register_atexit
+    hooks run LIFO at the start of threading._shutdown — i.e. BEFORE
+    concurrent.futures' own hook disables executors — so orbax (which
+    schedules futures internally) still works while we join the writer.
+    A plain atexit.register would fire too late: by then submit() raises
+    'cannot schedule new futures after interpreter shutdown'."""
+    global _EXIT_HOOKED
+    with _PENDING_LOCK:
+        if _EXIT_HOOKED:
+            return
+        _EXIT_HOOKED = True
+    try:
+        threading._register_atexit(_wait_pending_at_exit)
+    except Exception:  # private API; fall back to best-effort atexit
+        atexit.register(_wait_pending_at_exit)
+
+
+class _AsyncSave:
+    """Handle for one background checkpoint write."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._exc: Optional[BaseException] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def _run(self, write_fn):
+        try:
+            write_fn()
+            # success: deregister here. A FAILED handle stays in _PENDING
+            # until result() reports the error — otherwise a fast-failing
+            # write would vanish before wait_pending/restore could see it
+            # and the caller would trust a partial checkpoint.
+            with _PENDING_LOCK:
+                if _PENDING.get(self.path) is self:
+                    del _PENDING[self.path]
+        except BaseException as e:  # surfaced via result()/wait_pending()
+            self._exc = e
+            logging.getLogger("flexflow_tpu").error(
+                "async checkpoint write to %s failed: %s", self.path, e)
+
+    def start(self, write_fn):
+        self._thread = threading.Thread(
+            target=self._run, args=(write_fn,), daemon=True,
+            name=f"ff-ckpt-write:{os.path.basename(self.path)}")
+        self._thread.start()
+
+    def result(self, timeout: Optional[float] = None) -> str:
+        assert self._thread is not None
+        self._thread.join(timeout)
+        if self._thread.is_alive():
+            raise TimeoutError(f"checkpoint write to {self.path} still "
+                               f"running after {timeout}s")
+        # report the outcome exactly once, then deregister (so one failed
+        # save can't wedge every later save/wait on the same path)
+        with _PENDING_LOCK:
+            if _PENDING.get(self.path) is self:
+                del _PENDING[self.path]
+        if self._exc is not None:
+            raise self._exc
+        return self.path
+
+
+def wait_pending(path: Optional[str] = None) -> None:
+    """Join in-flight async checkpoint writes (all, or just `path`'s),
+    re-raising the first write error."""
+    with _PENDING_LOCK:
+        if path is None:
+            handles: List[_AsyncSave] = list(_PENDING.values())
+        else:
+            h = _PENDING.get(_ckpt_dir(path))
+            handles = [h] if h is not None else []
+    for h in handles:
+        h.result()
+
+
+# ------------------------------------------------------------------ save/load
+def _write_tree(ckptr, path: str, tree: Dict[str, Any], meta: Dict[str, Any],
+                state: Dict[str, np.ndarray]) -> None:
+    """The expensive half of a save: orbax serialization + metadata files.
+    Runs on the caller thread (block=True) or the writer thread. `ckptr`
+    must be constructed on the CALLER thread — orbax registers atexit
+    hooks at import/construction, which raises if the writer thread is
+    draining during interpreter shutdown (the _wait_pending_at_exit path)."""
     ckptr.save(os.path.join(path, "tree"), tree, force=True)
     ckptr.wait_until_finished()
     # small host-side metadata travels as json (numpy state arrays included)
+    if jax.process_index() == 0:
+        with open(os.path.join(path, "meta.json"), "w") as f:
+            json.dump(meta, f)
+        if state:
+            np.savez(os.path.join(path, "state.npz"), **state)
+
+
+def save_checkpoint(cm, path: str, block: bool = True) -> str:
+    """Persist a CompiledModel's full training state (params, optimizer
+    state, BN/running state, iteration, strategy) under `path`.
+
+    block=False (cfg.async_checkpoint through CompiledModel.save_checkpoint)
+    returns as soon as the state is snapshot to host; the write happens on
+    a background thread. Multi-process runs always write synchronously —
+    the per-process shards aren't host-gatherable, and orbax coordinates
+    the processes itself."""
+    import orbax.checkpoint as ocp
+
+    path = _ckpt_dir(path)
+    wait_pending(path)  # never interleave two writers on one directory
     meta = {
         "iteration": int(cm._iteration),
         "state_keys": sorted(cm.state),
         "strategy": cm.strategy.to_json(),
     }
-    if jax.process_index() == 0:
-        with open(os.path.join(path, "meta.json"), "w") as f:
-            json.dump(meta, f)
-        if cm.state:
-            np.savez(os.path.join(path, "state.npz"),
-                     **{k: np.asarray(v) for k, v in cm.state.items()})
+    state = {k: np.asarray(v) for k, v in cm.state.items()}
+    tree = {"params": cm.params, "opt_state": cm.opt_state}
+    ckptr = ocp.StandardCheckpointer()  # caller thread: see _write_tree
+    if block or jax.process_count() > 1:
+        _write_tree(ckptr, path, tree, meta, state)
+        return path
+    # copy-then-write: D2H snapshot here (donation-safe — the live buffers
+    # may be consumed by the next train_step), serialization off-thread
+    host_tree = jax.tree_util.tree_map(np.asarray, tree)
+    _register_exit_drain()
+    handle = _AsyncSave(path)
+    with _PENDING_LOCK:
+        _PENDING[path] = handle
+    handle.start(lambda: _write_tree(ckptr, path, host_tree, meta, state))
     return path
 
 
@@ -52,10 +189,12 @@ def restore_checkpoint(cm, path: str) -> None:
     """Restore `save_checkpoint` output into a CompiledModel built from the
     same model graph. Arrays land directly in the compiled shardings (the
     live params/opt_state trees are the restore targets); the iteration
-    counter resumes, so LR schedules and recompile triggers continue."""
+    counter resumes, so LR schedules and recompile triggers continue.
+    Joins any in-flight async write to `path` first."""
     import orbax.checkpoint as ocp
 
     path = _ckpt_dir(path)
+    wait_pending(path)
     if cm.params is None:
         cm.init()
     ckptr = ocp.StandardCheckpointer()

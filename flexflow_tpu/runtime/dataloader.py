@@ -66,19 +66,71 @@ def prefetch_to_device(it, input_shardings, label_sharding, depth: int = 2,
                        put=None):
     """Overlap host→device transfer with compute (double buffering).
     `put(arr, sharding)` overrides the transfer (multi-host runs pass the
-    global-array assembler from runtime/distributed.py)."""
+    global-array assembler from runtime/distributed.py). Implemented as
+    the k=1 case of prefetch_multi, untagged."""
+    for _kind, dx, dy in prefetch_multi(it, 1, input_shardings,
+                                        label_sharding, depth=depth, put=put):
+        yield dx, dy
+
+
+def prefetch_multi(it, k, input_shardings, label_sharding,
+                   stacked_input_shardings=None, stacked_label_sharding=None,
+                   depth: int = 2, put=None):
+    """K-step prefetcher for the fused-dispatch training loop
+    (CompiledModel.make_multi_step): groups `k` consecutive host batches,
+    np.stacks them into (k, ...) arrays, and transfers each group with the
+    STACKED shardings (leading step dim unsharded) — one transfer feeds one
+    k-step dispatch. Tail batches that don't fill a group transfer singly.
+
+    Yields ("k", dx, dy) for full stacked groups and ("1", dx, dy) for
+    singles: the epoch tail, and any batch whose shapes differ from its
+    group's (a ragged remainder batch flushes the partial group singly
+    rather than crashing np.stack). With k <= 1 it degenerates to tagged
+    prefetch_to_device. Worker exceptions are forwarded to the consumer
+    like prefetch_to_device (the queued items ahead of the exception still
+    drain first)."""
     q: "queue_mod.Queue" = queue_mod.Queue(maxsize=depth)
     _DONE = object()
     if put is None:
         put = jax.device_put
 
+    def _xfer(xs, y, in_sh, lab_sh):
+        dx = [put(x, s) if s is not None else jax.device_put(x)
+              for x, s in zip(xs, in_sh)]
+        dy = put(y, lab_sh) if lab_sh is not None else jax.device_put(y)
+        return dx, dy
+
+    def _shapes(xs, y):
+        return tuple(np.asarray(x).shape for x in xs) + (np.asarray(y).shape,)
+
     def worker():
         try:
+            buf: List = []
             for xs, y in it:
-                dx = [put(x, s) if s is not None else jax.device_put(x)
-                      for x, s in zip(xs, input_shardings)]
-                dy = put(y, label_sharding) if label_sharding is not None else jax.device_put(y)
-                q.put((dx, dy))
+                if k <= 1:
+                    q.put(("1",) + _xfer(xs, y, input_shardings, label_sharding))
+                    continue
+                if buf and _shapes(xs, y) != _shapes(*buf[0]):
+                    # ragged batch (e.g. short remainder): flush the
+                    # partial group singly — stacking would crash
+                    for bxs, by in buf:
+                        q.put(("1",) + _xfer(bxs, by, input_shardings,
+                                             label_sharding))
+                    buf = []
+                buf.append((xs, y))
+                if len(buf) == k:
+                    sx = [np.stack([b[0][i] for b in buf])
+                          for i in range(len(buf[0][0]))]
+                    sy = np.stack([b[1] for b in buf])
+                    q.put(("k",) + _xfer(
+                        sx, sy,
+                        stacked_input_shardings or input_shardings,
+                        stacked_label_sharding
+                        if stacked_label_sharding is not None
+                        else label_sharding))
+                    buf = []
+            for xs, y in buf:  # tail: fewer than k batches left
+                q.put(("1",) + _xfer(xs, y, input_shardings, label_sharding))
             q.put(_DONE)
         except BaseException as e:  # forward to the consumer, don't swallow
             q.put(e)

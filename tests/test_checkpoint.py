@@ -56,6 +56,58 @@ def test_checkpoint_roundtrip_and_exact_resume(devices, tmp_path):
                                np.asarray(cm1.get_weight("head")), rtol=1e-6)
 
 
+def test_async_checkpoint_nonblocking_and_correct(devices, tmp_path):
+    """Non-blocking save (copy-then-write thread): the snapshot is taken at
+    call time, training continues immediately — INCLUDING donating steps
+    that consume the live buffers — and the restore sees exactly the
+    state at the save point. restore waits for the in-flight write."""
+    x, y = _data()
+    m1, cm1 = _build()
+    cm1.init(seed=0)
+    cm1.fit(x, y, epochs=1, verbose=False)
+    w_at_save = np.asarray(cm1.get_weight("fc1"))
+    ck = cm1.save_checkpoint(str(tmp_path / "ck_async"), block=False)
+    # keep training while the writer thread persists the snapshot: the
+    # params the save captured must not be perturbed by these steps
+    cm1.fit(x, y, epochs=1, verbose=False)
+    assert not np.array_equal(np.asarray(cm1.get_weight("fc1")), w_at_save)
+    cm1.wait_checkpoints()  # joins + re-raises writer errors
+
+    m2, cm2 = _build()
+    cm2.init(seed=123)
+    cm2.load_checkpoint(ck)
+    assert cm2._iteration == 4
+    np.testing.assert_array_equal(np.asarray(cm2.get_weight("fc1")),
+                                  w_at_save)
+
+
+def test_async_checkpoint_drains_at_interpreter_exit(tmp_path):
+    """A save issued right before process exit must still land: the exit
+    drain (threading._register_atexit, runs before concurrent.futures
+    disables executors) joins the writer thread instead of letting the
+    daemon die mid-serialize."""
+    import subprocess
+    import sys
+
+    code = f"""
+import os
+os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS","") + " --xla_force_host_platform_device_count=8"
+import jax; jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from flexflow_tpu import FFModel, FFConfig, SGDOptimizer
+m = FFModel(FFConfig(batch_size=16, only_data_parallel=True))
+t = m.create_tensor([16, 8], name="x")
+m.dense(t, 4, name="fc")
+cm = m.compile(SGDOptimizer(lr=0.01), "sparse_categorical_crossentropy", [])
+cm.init(seed=0)
+cm.save_checkpoint({str(tmp_path / "ck")!r})  # async; exit immediately
+"""
+    r = subprocess.run([sys.executable, "-c", code], cwd="/root/repo",
+                       capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stderr[-3000:]
+    assert (tmp_path / "ck" / "meta.json").exists(), r.stderr[-3000:]
+
+
 def test_checkpoint_restores_into_shardings(devices, tmp_path):
     from flexflow_tpu.parallel.templates import apply_tensor_parallel_linear_pair
 
