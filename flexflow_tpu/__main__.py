@@ -35,6 +35,7 @@ def main(argv=None):
         "--seq-length", "--simulator-mode", "--simulator-segment-size",
         "--simulator-topk", "--simulator-trace",
         "--sync-every", "--steps-per-dispatch", "--dispatch-ahead",
+        "--zero-sharding", "--accum-steps",
     }
     script = None
     i = 0

@@ -37,7 +37,9 @@ from flexflow_tpu.optimizers import Optimizer, SGDOptimizer
 from flexflow_tpu.parallel.default_strategy import data_parallel_strategy
 from flexflow_tpu.parallel.machine import MachineSpec, build_mesh
 from flexflow_tpu.parallel.sharding import Strategy
-from flexflow_tpu.runtime.dataloader import (SingleDataLoader, prefetch_multi,
+from flexflow_tpu.runtime.dataloader import (SingleDataLoader,
+                                             group_microbatches,
+                                             prefetch_multi,
                                              prefetch_to_device)
 
 
@@ -55,7 +57,7 @@ def _search_machine(cfg, machine: MachineSpec) -> MachineSpec:
                        dcn_axes=("data",) if nodes > 1 else ())
 
 
-def _pick_strategy(model, machine: MachineSpec) -> Strategy:
+def _pick_strategy(model, machine: MachineSpec, optimizer=None) -> Strategy:
     cfg = model.config
     if cfg.import_strategy_file:
         return Strategy.load(cfg.import_strategy_file)
@@ -77,7 +79,9 @@ def _pick_strategy(model, machine: MachineSpec) -> Strategy:
 
             warnings.warn("strategy search unavailable; falling back to data-parallel")
         else:
-            return graph_optimize(model, sm)
+            # the optimizer rides along so the search's memory model can
+            # price its moments (count/state_dtype/ZeRO divisor) honestly
+            return graph_optimize(model, sm, optimizer=optimizer)
     return data_parallel_strategy(model, machine)
 
 
@@ -125,17 +129,54 @@ def compile_model(model, optimizer, loss_type: LossType, metrics: Sequence[Metri
     if lg.level == logging.NOTSET:  # never clobber application logging config
         lg.setLevel(level)
     mesh = build_mesh(machine)
-    strategy = _pick_strategy(model, machine)
+    optimizer = optimizer or SGDOptimizer(lr=cfg.learning_rate)
+    strategy = _pick_strategy(model, machine, optimizer)
     logging.getLogger("flexflow_tpu").info(
         "compile: mesh=%s strategy=%s", dict(machine.mesh_axes), strategy.name)
     _overlay_parallel_ops(model, strategy)
     if cfg.export_strategy_file:
         strategy.save(cfg.export_strategy_file)
-    optimizer = optimizer or SGDOptimizer(lr=cfg.learning_rate)
     if outputs is None:
         outputs = model.layers[-1].outputs[:1] if model.layers else []
     return CompiledModel(model, machine, mesh, strategy, optimizer,
                          loss_type, list(metrics), list(outputs))
+
+
+def _zero_axes_of(mesh: Mesh) -> List[str]:
+    """Mesh axes ZeRO shards optimizer moments over: the batch axes
+    (candidates._batch_axes convention — "node"/"data", else the first
+    axis) with degree > 1. Sharding over the batch axes is what removes
+    REDUNDANT state: every other axis already partitions the params."""
+    axes = [a for a in ("node", "data") if a in mesh.shape]
+    if not axes and mesh.shape:
+        axes = [next(iter(mesh.shape))]
+    return [a for a in axes if mesh.shape[a] > 1]
+
+
+def _zero_moment_pspec(pspec: PartitionSpec, shape, mesh: Mesh,
+                       zero_axes: Sequence[str]) -> PartitionSpec:
+    """Moment layout for one param under ZeRO: the param's own spec plus
+    the FULL data-axis degree on the first unsharded dim it divides. A
+    param with no such dim keeps its (possibly model-sharded) layout —
+    its moments stay replicated over data, exactly what the search's
+    cost_model.zero_divisor mirror predicts. Keep the two rules in
+    lockstep or --memory-search prices memory the runtime doesn't save."""
+    spec = list(pspec) + [None] * (len(shape) - len(pspec))
+    used = {a for d in spec if d is not None
+            for a in ((d,) if isinstance(d, str) else tuple(d))}
+    if used & set(zero_axes):
+        return PartitionSpec(*spec)
+    deg = 1
+    for a in zero_axes:
+        deg *= mesh.shape[a]
+    if deg <= 1:
+        return PartitionSpec(*spec)
+    for i, d in enumerate(spec):
+        if d is None and shape[i] % deg == 0:
+            spec[i] = zero_axes[0] if len(zero_axes) == 1 \
+                else tuple(zero_axes)
+            break
+    return PartitionSpec(*spec)
 
 
 @partial(jax.jit, donate_argnums=(0,))
@@ -175,6 +216,9 @@ class CompiledModel:
                                         seq_length=self.cfg.seq_length or None,
                                         compute_dtype=self.cfg.compute_dtype,
                                         enable_fusion=self.cfg.enable_fusion)
+        # gradient-accumulation width the step functions are built for
+        # (cfg default; fit(accum_steps=...) rebuilds on a different value)
+        self._accum_steps = max(1, int(self.cfg.accum_steps))
         self._build_steps()
         self.params = None
         self.state: Dict[str, Any] = {}
@@ -209,6 +253,66 @@ class CompiledModel:
         from flexflow_tpu.runtime.distributed import global_batch_from_full
 
         return global_batch_from_full(np.asarray(arr), self.mesh, sharding.spec)
+
+    # ------------------------------------------------- zero-redundancy state
+    def _zero_mode(self) -> str:
+        """Resolved ZeRO regime: cfg.zero_sharding, degraded to "off" when
+        the mesh has no batch axis to shard over (1-device runs)."""
+        mode = (self.cfg.zero_sharding or "off").lower()
+        if mode not in ("off", "zero1", "zero2"):
+            raise ValueError(f"zero_sharding={self.cfg.zero_sharding!r} "
+                             "(choose from off/zero1/zero2)")
+        if mode != "off" and not _zero_axes_of(self.mesh):
+            return "off"
+        return mode
+
+    def _param_templates(self):
+        """params-shaped trees of avals + compiled shardings, WITHOUT
+        materializing arrays — mirrors init()'s params structure (one dict
+        per weighted layer), so tx.init's state shape can be derived before
+        any weight exists."""
+        shapes: Dict[str, Dict[str, jax.ShapeDtypeStruct]] = {}
+        shards: Dict[str, Dict[str, NamedSharding]] = {}
+        for layer in topo_order(self.model.layers):
+            if not layer.weight_specs:
+                continue
+            shapes[layer.name] = {
+                w: jax.ShapeDtypeStruct(s.shape, s.dtype.jnp_dtype)
+                for w, s in layer.weight_specs.items()}
+            shards[layer.name] = {
+                w: self._weight_sharding(layer.name, w, s.shape)
+                for w, s in layer.weight_specs.items()}
+        return shapes, shards
+
+    def _moment_shardings(self, pshapes, pshards):
+        """Per-param layout of the optimizer moments: the param's own
+        sharding (the replicated regime / zero off), or that plus the
+        data-axis degree on the first divisible free dim (ZeRO)."""
+        if self._zero_mode() == "off":
+            return pshards
+        za = _zero_axes_of(self.mesh)
+        return jax.tree_util.tree_map(
+            lambda sds, sh: NamedSharding(self.mesh, _zero_moment_pspec(
+                sh.spec, sds.shape, self.mesh, za)), pshapes, pshards)
+
+    def _opt_state_shardings(self, pshapes, moment_sh):
+        """Sharding tree matching tx.init's FULL state structure (for the
+        jitted init's out_shardings and the in-step constraints): optax
+        states embed params-shaped subtrees for the moments — those get
+        `moment_sh` — while everything else (step counts, EmptyState)
+        replicates."""
+        repl = NamedSharding(self.mesh, PartitionSpec())
+        shapes = jax.eval_shape(self.tx.init, pshapes)
+        pstruct = jax.tree_util.tree_structure(pshapes)
+        if pstruct.num_leaves == 0:
+            return jax.tree_util.tree_map(lambda _: repl, shapes)
+
+        def is_params_subtree(x):
+            return jax.tree_util.tree_structure(x) == pstruct
+
+        return jax.tree_util.tree_map(
+            lambda sub: moment_sh if is_params_subtree(sub) else repl,
+            shapes, is_leaf=is_params_subtree)
 
     # ---------------------------------------------------------------- init
     def init(self, seed: Optional[int] = None):
@@ -261,7 +365,12 @@ class CompiledModel:
 
         self.params = jax.jit(init_fn, out_shardings=shardings)(jax.random.PRNGKey(seed))
         self.state = {}
-        self.opt_state = self.tx.init(self.params)
+        # jitted with EXPLICIT out_shardings (vs the old eager tx.init):
+        # moments land directly in their target layout — sharded from the
+        # first byte under ZeRO, and never paying the transient
+        # fully-replicated allocation implicit propagation produced
+        self.opt_state = jax.jit(self.tx.init,
+                                 out_shardings=self._opt_sh)(self.params)
         self._iteration = 0
         return self.params
 
@@ -278,7 +387,19 @@ class CompiledModel:
 
         regularizers = dict(self.model._weight_regularizers)
 
-        def train_step(params, opt_state, state, inputs, label, rng):
+        # ZeRO machinery: the moment/opt-state sharding trees are fixed by
+        # (strategy, mesh, optimizer), so build them once per compile and
+        # share between the jitted tx.init (see init()) and the in-step
+        # constraints below
+        zero = self._zero_mode()
+        accum = max(1, int(self._accum_steps))
+        pshapes, pshards = self._param_templates()
+        moment_sh = self._moment_sh = self._moment_shardings(pshapes, pshards)
+        self._param_sh = pshards
+        opt_sh = self._opt_sh = self._opt_state_shardings(pshapes, moment_sh)
+        wsc = jax.lax.with_sharding_constraint
+
+        def value_and_grads(params, state, inputs, label, rng):
             def loss_fn(p):
                 fwd = forward_fn
                 if remat:
@@ -293,12 +414,74 @@ class CompiledModel:
                                              else jnp.sum(w * w))
                 return loss, (logits, new_state)
 
-            (loss, (logits, new_state)), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(params)
+            return jax.value_and_grad(loss_fn, has_aux=True)(params)
+
+        def apply_update(params, opt_state, grads):
+            """One optimizer update. Under ZeRO this is the rewritten sync:
+            constraining the (all-reduced) grads to the moment layout lets
+            GSPMD lower the sync as reduce-scatter, each device updates
+            only ITS moment shard, and the param-dtype updates all-gather
+            back — same ring volume as the fused all-reduce
+            (cost_model.grad_sync_time zero=True), 1/degree the moment
+            memory and update flops."""
+            if zero != "off":
+                grads = wsc(grads, moment_sh)
             updates, opt_state = tx.update(grads, opt_state, params)
-            params = optax.apply_updates(params, updates)
+            if zero != "off":
+                updates = wsc(updates, pshards)      # all-gather
+                opt_state = wsc(opt_state, opt_sh)   # moments stay sharded
+            return optax.apply_updates(params, updates), opt_state
+
+        def train_step(params, opt_state, state, inputs, label, rng):
+            (loss, (logits, new_state)), grads = value_and_grads(
+                params, state, inputs, label, rng)
+            params, opt_state = apply_update(params, opt_state, grads)
             mvals = compute_metrics(metric_types, logits.astype(jnp.float32), label)
             return params, opt_state, new_state, loss, mvals
+
+        def accum_step(params, opt_state, state, inputs, label, rng):
+            """accum_steps=N microbatching: inputs/label carry a leading
+            (N, ...) microbatch dim (runtime/dataloader.group_microbatches);
+            N fwd/bwd passes accumulate a device-resident mean gradient and
+            ONE optimizer update applies it — effective batch N x batch.
+            Same signature as train_step, so make_multi_step fuses K
+            UPDATES per dispatch unchanged. Under zero2 each microbatch's
+            gradient is reduce-scattered before accumulation, so the
+            accumulator is stored sharded like the moments (zero1 keeps
+            full-size accumulators). Loss/metrics are means over the N
+            microbatches. Microbatch j uses fold_in(rng, j) — dropout
+            streams differ from an equivalent big-batch step by design."""
+            def micro(j, state):
+                ins = [jax.lax.dynamic_index_in_dim(a, j, keepdims=False)
+                       for a in inputs]
+                lab = jax.lax.dynamic_index_in_dim(label, j, keepdims=False)
+                (loss, (logits, new_state)), grads = value_and_grads(
+                    params, state, ins, lab, jax.random.fold_in(rng, j))
+                if zero == "zero2":
+                    grads = wsc(grads, moment_sh)
+                mvals = compute_metrics(metric_types,
+                                        logits.astype(jnp.float32), lab)
+                return new_state, grads, loss, mvals
+
+            def body(j, carry):
+                s, g, lsum, msum = carry
+                s, g2, l2, mv2 = micro(j, s)
+                tm = jax.tree_util.tree_map
+                return (s, tm(jnp.add, g, g2), lsum + l2,
+                        tm(jnp.add, msum, mv2))
+
+            # microbatch 0 outside the loop fixes the carry's shapes (the
+            # make_multi_step convention)
+            s, g, lsum, msum = micro(0, state)
+            s, g, lsum, msum = jax.lax.fori_loop(1, accum, body,
+                                                 (s, g, lsum, msum))
+            inv = 1.0 / accum
+            g = jax.tree_util.tree_map(lambda t: t * inv, g)
+            params, opt_state = apply_update(params, opt_state, g)
+            return params, opt_state, s, lsum * inv, \
+                jax.tree_util.tree_map(lambda x: x * inv, msum)
+
+        step_fn = accum_step if accum > 1 else train_step
 
         def eval_step(params, state, inputs, label):
             outs, _ = forward_fn(params, state, inputs, False, jax.random.PRNGKey(0))
@@ -323,10 +506,10 @@ class CompiledModel:
         # donate_state=False keeps the previous params/opt/state buffers
         # alive after each step (debugging / external references)
         donate = (0, 1, 2) if self.cfg.donate_state else ()
-        self.train_step = jax.jit(_wrap(train_step), donate_argnums=donate)
+        self.train_step = jax.jit(_wrap(step_fn), donate_argnums=donate)
         self.eval_step = jax.jit(_wrap(eval_step))
         self.infer_step = jax.jit(_wrap(infer))
-        self._train_step_fn = train_step  # unjitted body for make_multi_step
+        self._train_step_fn = step_fn  # unjitted body for make_multi_step
         self._wrap_precision = _wrap
         self._multi_cache = {}  # steps_per_dispatch -> jitted multi-step
 
@@ -408,13 +591,23 @@ class CompiledModel:
     def fit(self, x, y, batch_size: Optional[int] = None, epochs: Optional[int] = None,
             callbacks=None, verbose: bool = True,
             sync_every: Optional[int] = None,
-            steps_per_dispatch: Optional[int] = None):
+            steps_per_dispatch: Optional[int] = None,
+            accum_steps: Optional[int] = None):
         # per-call overrides of the async-pipeline knobs (see config.py);
         # None = the config's value, threaded through (cfg never mutated)
         if sync_every is None:
             sync_every = self.cfg.sync_every
         if steps_per_dispatch is None:
             steps_per_dispatch = self.cfg.steps_per_dispatch
+        if accum_steps is None:
+            accum_steps = self.cfg.accum_steps
+        if max(1, int(accum_steps)) != self._accum_steps:
+            # the accumulation width is baked into the jitted step
+            # functions: a different per-call value (or reverting to the
+            # config's after an override) rebuilds them (and clears the
+            # fused multi-step cache)
+            self._accum_steps = max(1, int(accum_steps))
+            self._build_steps()
         return self._fit(x, y, batch_size, epochs, callbacks, verbose,
                          sync_every, steps_per_dispatch)
 
@@ -482,10 +675,23 @@ class CompiledModel:
         per_batch_cbs = [cb for cb in callbacks or []
                          if hasattr(cb, "on_batch_end")]
         ahead = max(1, int(self.cfg.dispatch_ahead))
+        # accum_steps=N: the loop's unit becomes an (N, ...)-stacked
+        # accumulation group (group_microbatches below) — one dispatch of
+        # the accumulating step = one optimizer update over N microbatches.
+        # The unit shardings gain a leading unsharded microbatch dim; the
+        # K-fused stacking then rides on top ((K, N, ...) transfers).
+        accum = max(1, int(self._accum_steps))
+        if accum > 1:
+            in_sh_u = [NamedSharding(self.mesh, PartitionSpec(None, *s.spec))
+                       for s in in_sh]
+            lab_sh_u = NamedSharding(self.mesh,
+                                     PartitionSpec(None, *lab_sh.spec))
+        else:
+            in_sh_u, lab_sh_u = in_sh, lab_sh
         in_sh_k = [NamedSharding(self.mesh, PartitionSpec(None, *s.spec))
-                   for s in in_sh]
+                   for s in in_sh_u]
         lab_sh_k = NamedSharding(self.mesh,
-                                 PartitionSpec(None, *lab_sh.spec))
+                                 PartitionSpec(None, *lab_sh_u.spec))
         stats = self.step_stats = {"dispatches": 0, "host_syncs": 0,
                                    "barriers": 0, "fused_steps": 0}
         for epoch in range(epochs):
@@ -510,7 +716,8 @@ class CompiledModel:
             ep_disp = ep_sync = 0
             since_sync = 0
             for kind, dx, dy in prefetch_multi(
-                    loader.epoch(), k, in_sh, lab_sh, in_sh_k, lab_sh_k,
+                    group_microbatches(loader.epoch(), accum), k,
+                    in_sh_u, lab_sh_u, in_sh_k, lab_sh_k,
                     put=self._put):
                 if kind == "k":
                     (self.params, self.opt_state, self.state, loss,
@@ -531,7 +738,7 @@ class CompiledModel:
                 ep_disp += 1
                 stats["dispatches"] += 1
                 pml.update_deferred(steps, {"loss": loss})
-                pm.update_deferred(batch_size * steps, mvals)
+                pm.update_deferred(batch_size * accum * steps, mvals)
                 if sync and since_sync >= sync:
                     pml.materialize()
                     pm.materialize()
@@ -636,6 +843,58 @@ class CompiledModel:
                 return layout_match
         return cands[0]
 
+    def memory_stats(self) -> dict:
+        """Per-device persistent-memory report: what the search-side cost
+        model PREDICTS for this compile's strategy + optimizer (params +
+        grads + moments under the OptMemSpec accounting, ZeRO divisor
+        included) next to what the live buffers ACTUALLY hold (summed
+        addressable-shard bytes on device 0). tools/bench_zero.py asserts
+        the two agree on the ~data-degree optimizer-state reduction."""
+        from flexflow_tpu.search import cost_model as cmod
+
+        opt_mem = cmod.opt_mem_spec(self.optimizer, self.cfg, self.machine)
+        pred_w = pred_opt = 0
+        for layer in self.model.layers:
+            if not layer.weight_specs:
+                continue
+            cand = self._candidate_for(layer)
+            pred_w += cand.weight_mem_bytes(layer, self.machine, opt_mem)
+            for w, spec in layer.weight_specs.items():
+                dims = cand.weight_dims.get(w, [])
+                elems = cmod.shard_bytes(spec, dims, self.machine) \
+                    // max(1, spec.dtype.itemsize)
+                pred_opt += (opt_mem.moments * elems * opt_mem.state_itemsize
+                             // cmod.zero_divisor(spec, dims, self.machine,
+                                                  opt_mem.zero_axes))
+
+        def per_device_bytes(tree):
+            if tree is None:
+                return 0
+            dev = jax.devices()[0]
+            total = 0
+            for leaf in jax.tree_util.tree_leaves(tree):
+                shards = getattr(leaf, "addressable_shards", None)
+                if shards is None:
+                    total += int(getattr(leaf, "nbytes", 0))
+                    continue
+                total += sum(s.data.nbytes for s in shards
+                             if s.device == dev)
+            return total
+
+        za = _zero_axes_of(self.mesh)
+        deg = 1
+        for a in za:
+            deg *= self.mesh.shape[a]
+        return {
+            "zero_sharding": self._zero_mode(),
+            "data_axis_degree": deg,
+            "predicted_weight_state_bytes": int(pred_w),
+            "predicted_opt_state_bytes": int(pred_opt),
+            "actual_param_bytes_per_device": per_device_bytes(self.params),
+            "actual_opt_state_bytes_per_device":
+                per_device_bytes(self.opt_state),
+        }
+
     def search_cache_stats(self) -> dict:
         """Search fast-path observability: this compile's strategy-cache
         event, the process-wide cache counters, the memoized-costing hit
@@ -701,6 +960,17 @@ class CompiledModel:
                   f"expansions={dp.get('expansions', 0)} "
                   f"prefix_skipped_layers={dp.get('layers_skipped', 0)}; "
                   f"{memo.stats_line()}")
+            mem = self.memory_stats()
+            mb = 1024 * 1024
+            print(f"[memory] zero={mem['zero_sharding']} "
+                  f"data_degree={mem['data_axis_degree']} "
+                  f"predicted/device: weight-state "
+                  f"{mem['predicted_weight_state_bytes'] / mb:.2f}MB "
+                  f"(opt {mem['predicted_opt_state_bytes'] / mb:.2f}MB)")
+            print(f"[memory] actual/device:    params "
+                  f"{mem['actual_param_bytes_per_device'] / mb:.2f}MB, "
+                  f"opt state "
+                  f"{mem['actual_opt_state_bytes_per_device'] / mb:.2f}MB")
         return rows
 
     def export_sim_trace(self, path: str):

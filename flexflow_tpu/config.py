@@ -78,6 +78,25 @@ class FFConfig:
     # host on the caller thread (donation-safe), serialization + fsync on a
     # background writer thread; restore/exit wait for pending writes
     async_checkpoint: bool = True
+    # zero-redundancy data parallelism (compiler/compile.py): shard the
+    # optimizer moments over the batch ("data"/"node") mesh axes instead of
+    # replicating them, and rewrite the update as reduce-scatter(grads) ->
+    # sharded moment update -> all-gather(updates).
+    #   "off"   — moments replicated over the data axes (the reference's
+    #             fully-replicated NCCL regime)
+    #   "zero1" — moments sharded; gradients/accumulators stay full-size
+    #   "zero2" — zero1 + gradient ACCUMULATORS (accum_steps > 1) stored
+    #             reduce-scattered, so long accumulation windows don't pay
+    #             a full-size gradient residency either
+    # The search's memory model follows the knob (search/cost_model.py
+    # OptMemSpec), so --memory-search prices the sharded moments.
+    zero_sharding: str = "off"
+    # gradient accumulation: fold N consecutive loader microbatches into ONE
+    # optimizer update (device-resident accumulators, effective batch =
+    # N x batch_size). Composes with steps_per_dispatch (K fused UPDATES per
+    # dispatch) and the deferred-metrics loop. Microbatches beyond the last
+    # full group of an epoch are dropped (drop_remainder semantics).
+    accum_steps: int = 1
     # execution
     enable_fusion: bool = True
     profiling: bool = False
@@ -160,6 +179,9 @@ class FFConfig:
         p.add_argument("--dispatch-ahead", type=int, default=32)
         p.add_argument("--async-checkpoint", action=argparse.BooleanOptionalAction,
                        default=True)
+        p.add_argument("--zero-sharding", type=str, default="off",
+                       choices=("off", "zero1", "zero2"))
+        p.add_argument("--accum-steps", type=int, default=1)
         p.add_argument("--fusion", dest="fusion", action="store_true", default=True)
         p.add_argument("--no-fusion", dest="fusion", action="store_false")
         p.add_argument("--profiling", action="store_true")
@@ -209,6 +231,8 @@ class FFConfig:
             steps_per_dispatch=args.steps_per_dispatch,
             dispatch_ahead=args.dispatch_ahead,
             async_checkpoint=args.async_checkpoint,
+            zero_sharding=args.zero_sharding,
+            accum_steps=args.accum_steps,
             enable_fusion=args.fusion,
             profiling=args.profiling,
             profile_dir=args.profile_dir,

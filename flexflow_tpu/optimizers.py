@@ -22,6 +22,15 @@ class Optimizer:
     def to_optax(self) -> optax.GradientTransformation:
         raise NotImplementedError
 
+    # --- optimizer-state memory descriptor (consumed by the search's memory
+    # model, search/cost_model.py OptMemSpec): how many per-param moment
+    # tensors this optimizer carries, and the dtype they are STORED in.
+    def moment_count(self) -> int:
+        return 2  # conservative default (Adam-shaped)
+
+    def moment_itemsize(self) -> int:
+        return 4
+
 
 class SGDOptimizer(Optimizer):
     def __init__(self, ffmodel=None, lr: float = 0.01, momentum: float = 0.0,
@@ -37,6 +46,9 @@ class SGDOptimizer(Optimizer):
             parts.append(optax.add_decayed_weights(self.weight_decay))
         parts.append(optax.sgd(self.lr, momentum=self.momentum or None, nesterov=self.nesterov))
         return optax.chain(*parts)
+
+    def moment_count(self) -> int:
+        return 1 if self.momentum else 0  # the momentum trace
 
 
 def _scale_by_adam_lowp(b1: float, b2: float, eps: float, state_dtype):
@@ -99,6 +111,15 @@ class AdamOptimizer(Optimizer):
         self.weight_decay = weight_decay
         self.epsilon = epsilon
         self.state_dtype = state_dtype
+
+    def moment_count(self) -> int:
+        return 2  # mu + nu
+
+    def moment_itemsize(self) -> int:
+        import numpy as np
+
+        sd = self.state_dtype or "float32"
+        return 2 if sd == "bfloat16" else np.dtype(sd).itemsize
 
     # bf16 only: it shares fp32's exponent range, so the stored nu moment
     # cannot overflow. fp16 (max 65504) would overflow nu to inf for

@@ -167,6 +167,11 @@ def save_checkpoint(cm, path: str, block: bool = True) -> str:
         "iteration": int(cm._iteration),
         "state_keys": sorted(cm.state),
         "strategy": cm.strategy.to_json(),
+        # the mesh the (possibly ZeRO-sharded) opt state was laid out on:
+        # restore logs a re-shard when the restoring mesh differs (orbax
+        # stores GLOBAL arrays, so the re-shard is just a different slicing)
+        "mesh_axes": dict(cm.machine.mesh_axes),
+        "zero_sharding": getattr(cm.cfg, "zero_sharding", "off"),
     }
     state = {k: np.asarray(v) for k, v in cm.state.items()}
     tree = {"params": cm.params, "opt_state": cm.opt_state}
@@ -197,6 +202,18 @@ def restore_checkpoint(cm, path: str) -> None:
     wait_pending(path)
     if cm.params is None:
         cm.init()
+    with open(os.path.join(path, "meta.json")) as f:
+        meta = json.load(f)
+    saved_mesh = meta.get("mesh_axes")
+    if saved_mesh and dict(saved_mesh) != dict(cm.machine.mesh_axes):
+        # mesh changed between save and restore (e.g. ZeRO moments saved
+        # under data=4 restored under data=2): the checkpoint holds GLOBAL
+        # arrays, and the live target trees below carry the NEW mesh's
+        # shardings, so orbax re-shards on read — values are unchanged,
+        # only the per-device slicing moves
+        logging.getLogger("flexflow_tpu").info(
+            "checkpoint %s saved on mesh %s, restoring onto %s (re-shard)",
+            path, dict(saved_mesh), dict(cm.machine.mesh_axes))
     ckptr = ocp.StandardCheckpointer()
     target = {"params": cm.params, "opt_state": cm.opt_state}
     restored = ckptr.restore(os.path.join(path, "tree"), target)
@@ -216,8 +233,6 @@ def restore_checkpoint(cm, path: str) -> None:
     cm.params = jax.tree_util.tree_map(_placed, restored["params"], cm.params)
     cm.opt_state = jax.tree_util.tree_map(_placed, restored["opt_state"],
                                           cm.opt_state)
-    with open(os.path.join(path, "meta.json")) as f:
-        meta = json.load(f)
     cm._iteration = int(meta.get("iteration", 0))
     state_file = os.path.join(path, "state.npz")
     if os.path.exists(state_file):

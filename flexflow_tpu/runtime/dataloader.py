@@ -62,6 +62,38 @@ class SingleDataLoader:
             yield [self._take(x, idx) for x in self.xs], self._take(self.y, idx)
 
 
+def _batch_shapes(xs, y):
+    """Shape fingerprint of one (inputs, label) batch — the ragged-batch
+    guards in group_microbatches and prefetch_multi key on it."""
+    return tuple(np.asarray(x).shape for x in xs) + (np.asarray(y).shape,)
+
+
+def group_microbatches(it, n: int):
+    """Gradient-accumulation grouper (CompiledModel accum_steps): stack `n`
+    consecutive host batches into (n, ...) arrays — ONE yielded item feeds
+    one accumulating train step (n fwd/bwd passes, one optimizer update).
+    Runs BELOW prefetch_multi in the fit pipeline, so K accum-groups can
+    still fuse into a single (K, n, ...) dispatch. Microbatches that can't
+    complete a shape-uniform group are dropped (drop_remainder semantics —
+    a partial or ragged group would need its own jitted step shape): the
+    trailing short tail, and any group broken by a ragged batch (e.g. a
+    short remainder from a drop_remainder=False loader, which must not
+    crash np.stack — prefetch_multi's guard, same file)."""
+    if n <= 1:
+        yield from it
+        return
+    buf = []
+    for xs, y in it:
+        if buf and _batch_shapes(xs, y) != _batch_shapes(*buf[0]):
+            buf = []  # ragged boundary: the partial group can't stack
+        buf.append((xs, y))
+        if len(buf) == n:
+            yield ([np.stack([b[0][i] for b in buf])
+                    for i in range(len(buf[0][0]))],
+                   np.stack([b[1] for b in buf]))
+            buf = []
+
+
 def prefetch_to_device(it, input_shardings, label_sharding, depth: int = 2,
                        put=None):
     """Overlap host→device transfer with compute (double buffering).
@@ -100,9 +132,6 @@ def prefetch_multi(it, k, input_shardings, label_sharding,
         dy = put(y, lab_sh) if lab_sh is not None else jax.device_put(y)
         return dx, dy
 
-    def _shapes(xs, y):
-        return tuple(np.asarray(x).shape for x in xs) + (np.asarray(y).shape,)
-
     def worker():
         try:
             buf: List = []
@@ -110,7 +139,7 @@ def prefetch_multi(it, k, input_shardings, label_sharding,
                 if k <= 1:
                     q.put(("1",) + _xfer(xs, y, input_shardings, label_sharding))
                     continue
-                if buf and _shapes(xs, y) != _shapes(*buf[0]):
+                if buf and _batch_shapes(xs, y) != _batch_shapes(*buf[0]):
                     # ragged batch (e.g. short remainder): flush the
                     # partial group singly — stacking would crash
                     for bxs, by in buf:

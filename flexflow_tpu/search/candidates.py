@@ -101,12 +101,28 @@ class Candidate:
                                _batch_axes(machine))
         return t
 
-    def weight_mem_bytes(self, layer: "Layer", machine: MachineSpec) -> int:
-        # per-device, persistent: weights x4 (param, grad, 2 opt moments);
-        # activation memory is tracked as a live set by the DP (search/dp.py)
+    def weight_mem_bytes(self, layer: "Layer", machine: MachineSpec,
+                         opt_mem: "Optional[cm.OptMemSpec]" = None) -> int:
+        # per-device, persistent weight state; activation memory is tracked
+        # as a live set by the DP (search/dp.py). Legacy accounting
+        # (opt_mem=None — direct search_graph callers): weights x4 (param,
+        # grad, 2 f32 moments). With an OptMemSpec: param + grad at the
+        # weight dtype, plus the optimizer's ACTUAL moments — counted and
+        # sized by its state_dtype (bf16 Adam moments were previously
+        # charged as f32) and divided by the ZeRO data-axis degree where
+        # the runtime shards them (cost_model.zero_divisor mirrors the
+        # compile-side placement rule).
         m = 0
         for w, spec in layer.weight_specs.items():
-            m += 4 * cm.shard_bytes(spec, self.weight_dims.get(w, []), machine)
+            dims = self.weight_dims.get(w, [])
+            sb = cm.shard_bytes(spec, dims, machine)
+            if opt_mem is None:
+                m += 4 * sb
+                continue
+            shard_elems = sb // max(1, spec.dtype.itemsize)
+            moment_bytes = opt_mem.moments * shard_elems * opt_mem.state_itemsize
+            m += 2 * sb + moment_bytes // cm.zero_divisor(
+                spec, dims, machine, opt_mem.zero_axes)
         return m
 
 

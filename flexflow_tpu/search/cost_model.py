@@ -18,13 +18,76 @@ replaces the roofline term when enabled.
 
 from __future__ import annotations
 
+import dataclasses
 import math
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 from flexflow_tpu.core.tensor import TensorSpec
 from flexflow_tpu.parallel.machine import MachineSpec
 from flexflow_tpu.parallel.sharding import DimSharding
 from flexflow_tpu.search import memo
+
+
+@dataclasses.dataclass(frozen=True)
+class OptMemSpec:
+    """What the optimizer REALLY costs per parameter — the search's memory
+    model for persistent weight state (params + grads + moments). The
+    legacy accounting (opt_mem=None throughout the search) charged every
+    weight 4x its own bytes: param + grad + two f32 moments. This spec
+    replaces that with the optimizer's actual shape: `moments` moment
+    tensors stored at `state_itemsize` bytes/elem (bf16 Adam moments are 2,
+    not 4), divided by the ZeRO data-axis degree when `zero_axes` is set
+    (compiler/compile.py shards the moments over those axes — see
+    _zero_moment_pspec; zero_divisor mirrors its placement rule)."""
+
+    moments: int = 2
+    state_itemsize: int = 4
+    zero_axes: Tuple[str, ...] = ()
+
+    def fingerprint(self) -> tuple:
+        return (self.moments, self.state_itemsize, self.zero_axes)
+
+
+def opt_mem_spec(optimizer, cfg, machine: MachineSpec) -> Optional[OptMemSpec]:
+    """Build the search's optimizer-memory model from the compile-time
+    optimizer + config. None (no optimizer known) keeps the legacy 4x
+    accounting so direct search_graph callers are unaffected."""
+    if optimizer is None:
+        return None
+    zero_axes: Tuple[str, ...] = ()
+    if getattr(cfg, "zero_sharding", "off") != "off":
+        from flexflow_tpu.search.candidates import _batch_axes
+
+        zero_axes = tuple(a for a in _batch_axes(machine)
+                          if machine.mesh_axes.get(a, 1) > 1)
+    return OptMemSpec(moments=optimizer.moment_count(),
+                      state_itemsize=optimizer.moment_itemsize(),
+                      zero_axes=zero_axes)
+
+
+def zero_divisor(spec: TensorSpec, dims: Sequence[DimSharding],
+                 machine: MachineSpec, zero_axes: Sequence[str]) -> int:
+    """Degree the ZeRO runtime actually divides this weight's moments by.
+    MIRRORS compiler/compile.py _zero_moment_pspec: the moments take the
+    weight's own layout plus the full data-axis degree on the FIRST
+    unsharded dim it divides; a weight with no such dim keeps replicated
+    moments (divisor 1), and a weight already sharded over a data axis
+    gains nothing."""
+    if not zero_axes:
+        return 1
+    nd = spec.ndim
+    dims = list(dims or [])
+    dims += [None] * (nd - len(dims))
+    used = {a for d in dims for a in _axes_of(d)}
+    if used & set(zero_axes):
+        return 1
+    deg = axis_degree(zero_axes, machine)
+    if deg <= 1:
+        return 1
+    for i in range(nd):
+        if not _axes_of(dims[i]) and spec.shape[i] % deg == 0:
+            return deg
+    return 1
 
 
 def _axes_of(d: DimSharding) -> tuple:
@@ -79,9 +142,16 @@ def all_gather_time(full_bytes: float, axes, machine: MachineSpec) -> float:
     return _hier_gather_time(full_bytes, axes, machine)
 
 
+def reduce_scatter_time(bytes_: float, axes, machine: MachineSpec) -> float:
+    # ring reduce-scatter moves the same (k-1)/k * bytes as an all-gather,
+    # in the opposite direction
+    return _hier_gather_time(bytes_, axes, machine)
+
+
 def all_reduce_time(bytes_: float, axes, machine: MachineSpec) -> float:
     # reduce-scatter down + all-gather up, each hierarchical
-    return 2.0 * _hier_gather_time(bytes_, axes, machine)
+    return reduce_scatter_time(bytes_, axes, machine) \
+        + all_gather_time(bytes_, axes, machine)
 
 
 def all_to_all_time(shard_bytes_: float, axes, machine: MachineSpec) -> float:
@@ -163,31 +233,47 @@ def _reshard_time(spec: TensorSpec, src: Sequence[DimSharding],
 
 def grad_sync_time(weight_specs: Dict[str, TensorSpec],
                    weight_dims: Dict[str, List[DimSharding]],
-                   machine: MachineSpec, batch_axes: Sequence[str]) -> float:
-    """Gradient all-reduce over the replica axes of each weight (reference:
+                   machine: MachineSpec, batch_axes: Sequence[str],
+                   zero: bool = False) -> float:
+    """Gradient sync over the replica axes of each weight (reference:
     ncclAllReduce fused into the optimizer update, optimizer_kernel.cu:88).
-    Interned by (weight geometry, layouts, machine) — see search/memo.py."""
+    `zero` prices the ZeRO rewrite instead — reduce-scatter(grads) +
+    all-gather(updates); both tensors are param-sized, so on a ring the
+    total volume EQUALS the all-reduce's (the ZeRO win is memory, not
+    step-time comm — keep the two terms equal or the DP's compute/comm
+    overlap split in dp.py drifts from Candidate.op_time's internal sync
+    term). Interned by (weight geometry, layouts, machine) — see memo.py."""
     if not weight_specs:
         return 0.0
     if memo.enabled():
         key = (memo.freeze_weight_specs(weight_specs),
                tuple(sorted((w, memo.freeze_dims(d))
                             for w, d in weight_dims.items())),
-               tuple(batch_axes), memo.machine_fingerprint(machine))
+               tuple(batch_axes), zero, memo.machine_fingerprint(machine))
         t = memo.get("grad_sync", key)
         if t is not memo.MISS:
             return t
         return memo.put("grad_sync", key, _grad_sync_time(
-            weight_specs, weight_dims, machine, batch_axes))
-    return _grad_sync_time(weight_specs, weight_dims, machine, batch_axes)
+            weight_specs, weight_dims, machine, batch_axes, zero))
+    return _grad_sync_time(weight_specs, weight_dims, machine, batch_axes,
+                           zero)
 
 
-def _grad_sync_time(weight_specs, weight_dims, machine, batch_axes) -> float:
+def _grad_sync_time(weight_specs, weight_dims, machine, batch_axes,
+                    zero=False) -> float:
     t = 0.0
     for w, spec in weight_specs.items():
         dims = weight_dims.get(w, [None] * spec.ndim)
         used = {a for d in dims for a in _axes_of(d)}
         replica_axes = tuple(a for a in batch_axes if a not in used)
-        if replica_axes:
-            t += all_reduce_time(shard_bytes(spec, dims, machine), replica_axes, machine)
+        if not replica_axes:
+            continue
+        b = shard_bytes(spec, dims, machine)
+        if zero:
+            # grads scatter down at full size, the param-dtype updates
+            # gather back up — same ring volume as the fused all-reduce
+            t += reduce_scatter_time(b, replica_axes, machine) \
+                + all_gather_time(b, replica_axes, machine)
+        else:
+            t += all_reduce_time(b, replica_axes, machine)
     return t

@@ -162,9 +162,19 @@ def search_graph(model, machine: MachineSpec, beam_width: int = 64,
                  pins: Optional[Dict[str, str]] = None,
                  topk: int = 1,
                  prefix_cache: Optional[DPPrefixCache] = None,
+                 opt_mem: "Optional[cm.OptMemSpec]" = None,
                  ) -> "SearchResult | List[SearchResult]":
     """cost_fn(layer, cand) -> seconds overrides the analytic op time
     (hook for the measured path, search/measure.py).
+
+    `opt_mem` (cost_model.OptMemSpec) is the optimizer's memory model:
+    moments counted and sized by the optimizer's actual state_dtype, and
+    divided by the ZeRO data-axis degree when zero sharding is on — so a
+    memory-constrained search prices data parallelism at what the runtime
+    really allocates. None keeps the legacy params-x4 accounting. Under
+    ZeRO the grad-sync term is priced as reduce-scatter + all-gather
+    (numerically equal to the all-reduce on a ring — see
+    cost_model.grad_sync_time).
 
     `prefix_cache` (tier-3 fast path) resumes the DP from the deepest beam
     snapshot whose canonical graph prefix + boundary liveness match this
@@ -317,11 +327,13 @@ def search_graph(model, machine: MachineSpec, beam_width: int = 64,
                     # whose collectives ride behind the next op's matmuls.
                     op_comm = cand.extra_comm + cm.grad_sync_time(
                         layer.weight_specs, cand.weight_dims, machine,
-                        _batch_axes_cached)
+                        _batch_axes_cached,
+                        zero=bool(opt_mem and opt_mem.zero_axes))
                     comp = max(0.0, total - op_comm)
                     c += cm.overlapped_step_cost(comp, edge_comm + op_comm,
                                                  machine)
-                    wm = w_mem + cand.weight_mem_bytes(layer, machine)
+                    wm = w_mem + cand.weight_mem_bytes(layer, machine,
+                                                       opt_mem)
                     out_dims = {
                         o.guid: _freeze_dims(cand.out_dims[oi] if oi < len(cand.out_dims)
                                              else [None] * o.spec.ndim)

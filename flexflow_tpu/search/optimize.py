@@ -36,7 +36,7 @@ def result_to_strategy(model, machine: MachineSpec, result: SearchResult) -> Str
 
 
 def graph_optimize(model, machine: MachineSpec,
-                   measured: bool = False) -> Strategy:
+                   measured: bool = False, optimizer=None) -> Strategy:
     """Unity search: graph substitutions (best-first under budget/alpha) over
     the frontier DP. Falls back to the plain DP when the engine is disabled
     (enable_parameter_parallel=False etc. restricts candidates either way).
@@ -48,9 +48,15 @@ def graph_optimize(model, machine: MachineSpec,
     the substitution loop or a single DP expansion."""
     import time
 
+    from flexflow_tpu.search import cost_model as cm
     from flexflow_tpu.search import strategy_cache as sc
 
     cfg = model.config
+    # the optimizer's memory model (moment count/dtype + ZeRO divisor):
+    # changes what memory-constrained searches predict, so it rides the
+    # cache key below
+    opt_mem = cm.opt_mem_spec(optimizer, cfg, machine)
+    opt_fp = repr(opt_mem.fingerprint()) if opt_mem is not None else ""
     use_cache = bool(getattr(cfg, "strategy_cache", True))
     cache_dir = sc.resolve_dir(cfg) if use_cache else None
     cost_fn = None
@@ -71,14 +77,15 @@ def graph_optimize(model, machine: MachineSpec,
     if use_cache:
         calib = sc.calibration_fingerprint(
             measure_cache_path if cost_fn else None)
-        key = sc.cache_key(model, machine, cfg, calib)
+        key = sc.cache_key(model, machine, cfg, calib, opt_fp)
         cached = sc.lookup(cache_dir, key, model, machine)
         if cached is not None:
             return cached
     from flexflow_tpu.search.unity import unity_optimize
 
     t0 = time.perf_counter()
-    st, stats = unity_optimize(model, machine, cost_fn=cost_fn)
+    st, stats = unity_optimize(model, machine, cost_fn=cost_fn,
+                               opt_mem=opt_mem)
     if use_cache:
         if cost_fn is not None:
             # the measured search wrote new microbenchmarks into the store
@@ -86,7 +93,7 @@ def graph_optimize(model, machine: MachineSpec,
             # the next run's lookup (which hashes the populated store)
             # finds this entry instead of orphaning it
             calib = sc.calibration_fingerprint(measure_cache_path)
-            key = sc.cache_key(model, machine, cfg, calib)
+            key = sc.cache_key(model, machine, cfg, calib, opt_fp)
         sc.store(cache_dir, key, st, meta={
             "cost_s": stats.best_cost,
             "baseline_cost_s": stats.baseline_cost,

@@ -111,7 +111,8 @@ def knob_fingerprint(cfg) -> str:
              cfg.enable_parameter_parallel, cfg.enable_attribute_parallel,
              cfg.base_optimize_threshold, cfg.memory_search, sub,
              cfg.simulator_mode, cfg.simulator_topk,
-             cfg.simulator_segment_size)
+             cfg.simulator_segment_size,
+             getattr(cfg, "zero_sharding", "off"))
     return hashlib.sha256(repr(knobs).encode()).hexdigest()[:16]
 
 
@@ -130,10 +131,13 @@ def calibration_fingerprint(measure_cache_path: Optional[str]) -> str:
 
 
 def cache_key(model, machine: MachineSpec, cfg,
-              calib_fp: str = "analytic") -> str:
+              calib_fp: str = "analytic", opt_fp: str = "") -> str:
+    # opt_fp: the OptMemSpec fingerprint (search/cost_model.py) — the
+    # optimizer's moment count/dtype and ZeRO axes change the memory
+    # accounting memory-constrained searches rank by
     parts = (CACHE_VERSION, graph_fingerprint(model),
              memo.machine_fingerprint(machine), knob_fingerprint(cfg),
-             calib_fp)
+             calib_fp, opt_fp)
     return hashlib.sha256(repr(parts).encode()).hexdigest()[:32]
 
 

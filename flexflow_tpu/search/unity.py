@@ -70,6 +70,7 @@ def substitution_optimize(pcg: PCG, machine: MachineSpec,
                           enable_parameter: bool = True,
                           enable_attribute: bool = True,
                           dp_cache: Optional[DPPrefixCache] = None,
+                          opt_mem=None,
                           ) -> Tuple[PCG, SearchResult, UnityStats]:
     """Best-first search over xfer applications (base_optimize analog).
 
@@ -86,7 +87,8 @@ def substitution_optimize(pcg: PCG, machine: MachineSpec,
                             mem_budget=mem_budget, cost_fn=cost_fn,
                             enable_parameter=enable_parameter,
                             enable_attribute=enable_attribute,
-                            pins=g.pins, prefix_cache=dp_cache)
+                            pins=g.pins, prefix_cache=dp_cache,
+                            opt_mem=opt_mem)
 
     r0 = cost(pcg)
     stats = UnityStats(baseline_cost=r0.cost, best_cost=r0.cost)
@@ -298,7 +300,8 @@ def _unfreeze(d):
 
 
 # ------------------------------------------------------------ entry point
-def unity_optimize(model, machine: MachineSpec, cost_fn=None) -> Tuple[Strategy, UnityStats]:
+def unity_optimize(model, machine: MachineSpec, cost_fn=None,
+                   opt_mem=None) -> Tuple[Strategy, UnityStats]:
     """graph_optimize with the substitution engine (the Unity search).
 
     Honors FFConfig: search_budget (expansion budget), search_alpha (prune
@@ -349,7 +352,7 @@ def unity_optimize(model, machine: MachineSpec, cost_fn=None) -> Tuple[Strategy,
                             mem_budget=mem_budget, cost_fn=cost_fn,
                             enable_parameter=en_param,
                             enable_attribute=en_attr, pins=g.pins,
-                            prefix_cache=dp_cache)
+                            prefix_cache=dp_cache, opt_mem=opt_mem)
 
     def _sim_refine(g: PCG, r: SearchResult) -> SearchResult:
         """simulator_mode='taskgraph': the additive DP prunes, the
@@ -376,7 +379,7 @@ def unity_optimize(model, machine: MachineSpec, cost_fn=None) -> Tuple[Strategy,
                                  enable_parameter=en_param,
                                  enable_attribute=en_attr, pins=g.pins,
                                  topk=cfg.simulator_topk,
-                                 prefix_cache=dp_cache)
+                                 prefix_cache=dp_cache, opt_mem=opt_mem)
         picked, _reports = sim.rerank(
             g, machine, finalists, cost_fn=cost_fn,
             segment_bytes=cfg.simulator_segment_size)
@@ -403,7 +406,7 @@ def unity_optimize(model, machine: MachineSpec, cost_fn=None) -> Tuple[Strategy,
                             mem_budget=mem_budget, cost_fn=cost_fn,
                             enable_parameter=en_param,
                             enable_attribute=en_attr, pins=pins,
-                            prefix_cache=dp_cache)
+                            prefix_cache=dp_cache, opt_mem=opt_mem)
                         best, refined_done = replayed, True
                     else:
                         best, best_r = replayed, _cost_pcg(replayed)
@@ -422,7 +425,7 @@ def unity_optimize(model, machine: MachineSpec, cost_fn=None) -> Tuple[Strategy,
                 alpha=cfg.search_alpha, beam_width=beam_width,
                 mem_budget=mem_budget, cost_fn=cost_fn,
                 enable_parameter=en_param, enable_attribute=en_attr,
-                dp_cache=dp_cache)
+                dp_cache=dp_cache, opt_mem=opt_mem)
             budget_left = max(0, budget_left - stats.expansions)
             seg_memo[k] = (stats.best_path, stats.baseline_cost, None)
             stats_all.expansions += stats.expansions
