@@ -18,26 +18,16 @@ import sys
 
 from flexflow_tpu.config import FFConfig
 
-def main(argv=None):
-    argv = list(sys.argv[1:] if argv is None else argv)
-    # the script is the first STANDALONE token (not a flag and not the value
-    # of a value-taking flag — e.g. `--machine-model-file mach.py train.py`
-    # must pick train.py)
-    value_flags = {
-        "-e", "--epochs", "-b", "--batch-size", "--lr", "--learning-rate",
-        "--wd", "--weight-decay", "--iterations", "--seed", "--mesh",
-        "--nodes", "-ll:tpu", "--workers-per-node", "--budget",
-        "--search-budget", "--alpha", "--search-alpha",
-        "--base-optimize-threshold", "--search-num-nodes",
-        "--search-num-workers", "--import", "--export",
-        "--substitution-json", "--machine-model-file", "--compute-dtype",
-        "--compgraph", "--profile-dir", "--strategy-cache-dir",
-        "--seq-length", "--simulator-mode", "--simulator-segment-size",
-        "--simulator-topk", "--simulator-trace",
-        "--sync-every", "--steps-per-dispatch", "--dispatch-ahead",
-        "--zero-sharding", "--accum-steps",
-    }
-    script = None
+def split_argv(argv, value_flags=None):
+    """Split launcher argv at the script path: the script is the first
+    STANDALONE token (not a flag and not the value of a value-taking flag —
+    e.g. `--machine-model-file mach.py train.py` must pick train.py).
+    `value_flags` defaults to the set DERIVED from the FFConfig parser
+    (FFConfig.launcher_value_flags), so newly added flags are covered
+    without touching this module. Returns (script, launcher_args,
+    script_args); script is None when argv holds no standalone token."""
+    if value_flags is None:
+        value_flags = FFConfig.launcher_value_flags()
     i = 0
     while i < len(argv):
         a = argv[i]
@@ -45,10 +35,14 @@ def main(argv=None):
             if "=" not in a and a in value_flags:
                 i += 1  # consume the flag's value token
         else:
-            script = a
-            launcher_args, script_args = argv[:i], argv[i + 1:]
-            break
+            return a, argv[:i], argv[i + 1:]
         i += 1
+    return None, argv, []
+
+
+def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    script, launcher_args, script_args = split_argv(argv)
     if script is None:
         print("usage: python -m flexflow_tpu [flags] script.py [script args]\n"
               "flags: the FFConfig CLI (-b, --budget, --mesh data=4,model=2, ...)",

@@ -128,8 +128,11 @@ def compile_model(model, optimizer, loss_type: LossType, metrics: Sequence[Metri
     lg = logging.getLogger("flexflow_tpu")
     if lg.level == logging.NOTSET:  # never clobber application logging config
         lg.setLevel(level)
-    mesh = build_mesh(machine)
     optimizer = optimizer or SGDOptimizer(lr=cfg.learning_rate)
+    if cfg.pipeline_stages > 1:
+        return _compile_pipelined(model, machine, optimizer, loss_type,
+                                  metrics, outputs)
+    mesh = build_mesh(machine)
     strategy = _pick_strategy(model, machine, optimizer)
     logging.getLogger("flexflow_tpu").info(
         "compile: mesh=%s strategy=%s", dict(machine.mesh_axes), strategy.name)
@@ -140,6 +143,85 @@ def compile_model(model, optimizer, loss_type: LossType, metrics: Sequence[Metri
         outputs = model.layers[-1].outputs[:1] if model.layers else []
     return CompiledModel(model, machine, mesh, strategy, optimizer,
                          loss_type, list(metrics), list(outputs))
+
+
+def _compile_pipelined(model, machine: MachineSpec, optimizer,
+                       loss_type: LossType, metrics, outputs):
+    """--pipeline-stages N: partition the graph into N sequential stages on
+    disjoint device groups. The machine description covers the FULL
+    cluster; the pipe dimension is carved out of it (an explicit pipe mesh
+    axis, else the batch axis degree divides by N — dp.stage_machine_for),
+    intra-stage layouts are searched on the STAGE machine (tensor/data
+    parallelism inside a stage composes with the pipeline split), and the
+    cut points come from the bubble-aware cut search when a search budget
+    is set, else from the balance heuristic. The schedule runs M =
+    cfg.accum_steps microbatches per optimizer update
+    (parallel/pipeline.py).
+
+    Known approximation: the cut search prices stage times under plain
+    per-stage frontier-DP layouts, while execution uses the (possibly
+    richer, substitution-searched) strategy from _pick_strategy — the
+    cuts are optimal for a close under-approximation of the executed
+    layouts, not for them exactly. Both searches are cold-compile-only:
+    the warm path (cached strategy with its pipeline block) skips both."""
+    from flexflow_tpu.parallel.pipeline import PipelinedModel, balanced_cuts
+    from flexflow_tpu.search.dp import search_pipelined, stage_machine_for
+
+    cfg = model.config
+    S = int(cfg.pipeline_stages)
+    stage_machine = stage_machine_for(machine, S)
+    strategy = _pick_strategy(model, stage_machine, optimizer)
+    if strategy.pipeline and int(strategy.pipeline.get("stages", S)) != S:
+        raise ValueError(f"imported strategy pipelines "
+                         f"{strategy.pipeline.get('stages')} stages but "
+                         f"--pipeline-stages is {S}")
+    if not strategy.pipeline:
+        # First compile at these knobs: graph_optimize stored the strategy
+        # (intra-stage layouts) BEFORE the pipeline block exists, so the
+        # cuts are searched here and the entry is re-stored WITH the block
+        # below — the warm path then finds strategy.pipeline set and skips
+        # the cut search entirely (zero DP expansions, the cache's
+        # headline contract; the knob fingerprint already keys on
+        # stages/schedule/M).
+        micro = max(1, int(cfg.accum_steps))
+        cuts = None
+        if cfg.search_budget > 0 and not cfg.only_data_parallel:
+            from flexflow_tpu.search import cost_model as cmod
+
+            r = search_pipelined(
+                model, machine, S, micro, schedule=cfg.pipeline_schedule,
+                mem_budget=machine.hbm_bytes if cfg.memory_search else None,
+                opt_mem=cmod.opt_mem_spec(optimizer, cfg, stage_machine))
+            if r is not None:
+                cuts = list(r.cuts)
+                logging.getLogger("flexflow_tpu").info(
+                    "pipeline cut search: cuts=%s predicted bubble=%.3f "
+                    "stage costs=%s", cuts, r.bubble,
+                    ["%.3g" % c for c in r.stage_costs])
+        if cuts is None:
+            cuts = balanced_cuts(model, stage_machine, S)
+        strategy.pipeline = {"stages": S, "cuts": cuts,
+                             "schedule": cfg.pipeline_schedule}
+        info = getattr(strategy, "_cache_info", None)
+        if info and info.get("dir") and info.get("key"):
+            # write the completed artifact (layouts + cuts) back into the
+            # cache entry graph_optimize created / hit
+            from flexflow_tpu.search import strategy_cache as sc
+
+            sc.store(info["dir"], info["key"], strategy,
+                     meta=dict(info.get("meta", {})))
+    _overlay_parallel_ops(model, strategy)
+    if cfg.export_strategy_file:
+        strategy.save(cfg.export_strategy_file)
+    if outputs is None:
+        outputs = model.layers[-1].outputs[:1] if model.layers else []
+    logging.getLogger("flexflow_tpu").info(
+        "compile: pipeline stages=%d schedule=%s stage_mesh=%s cuts=%s",
+        S, strategy.pipeline.get("schedule"),
+        dict(stage_machine.mesh_axes), strategy.pipeline.get("cuts"))
+    return PipelinedModel(model, machine, stage_machine, strategy,
+                          optimizer, loss_type, list(metrics),
+                          list(outputs))
 
 
 def _zero_axes_of(mesh: Mesh) -> List[str]:
@@ -177,6 +259,55 @@ def _zero_moment_pspec(pspec: PartitionSpec, shape, mesh: Mesh,
                 else tuple(zero_axes)
             break
     return PartitionSpec(*spec)
+
+
+def build_init_fn(layers, overrides, topo_idx=None):
+    """Weight-init closure shared by CompiledModel.init and the pipeline
+    runtime (parallel/pipeline.py): params for `layers`, each weight keyed
+    by fold_in(fold_in(key, topo_idx[layer]), weight_idx). `topo_idx` maps
+    a layer to its position in the FULL model's topo order (default: its
+    position in `layers`) — pipeline stages pass GLOBAL indices so a
+    stage-partitioned model initializes bitwise-identically to the
+    sequential compile of the same graph."""
+    from flexflow_tpu.core.tensor import TensorSpec
+
+    if topo_idx is None:
+        topo_idx = {id(l): i for i, l in enumerate(layers)}
+
+    def init_fn(key):
+        params = {}
+        for layer in layers:
+            if not layer.weight_specs:
+                continue
+            li = topo_idx[id(layer)]
+            d = {}
+            for i, (wname, spec) in enumerate(sorted(layer.weight_specs.items())):
+                # fork_join weights are "b{i}.{sublayer}.{wname}" (or
+                # "stk.{sublayer}.{wname}" stacked): the default
+                # initializer keys off the terminal wname
+                # fold by topo position (not guid) so identically-built
+                # models init identically across FFModel instances
+                k = jax.random.fold_in(jax.random.fold_in(key, li), i)
+                if wname.startswith("stk."):
+                    # stacked fork_join storage: init each branch slice
+                    # independently (fan-in/out from the SLICE shape, and
+                    # per-branch initializer overrides still apply)
+                    sspec = TensorSpec(spec.shape[1:], spec.dtype)
+                    default = default_initializer(wname.rsplit(".", 1)[-1])
+                    slices = []
+                    for b in range(spec.shape[0]):
+                        init = overrides.get(
+                            (layer.name, f"b{b}.{wname[4:]}")) or default
+                        slices.append(init(jax.random.fold_in(k, b), sspec))
+                    d[wname] = jnp.stack(slices)
+                else:
+                    init = overrides.get((layer.name, wname)) or \
+                        default_initializer(wname.rsplit(".", 1)[-1])
+                    d[wname] = init(k, spec)
+            params[layer.name] = d
+        return params
+
+    return init_fn
 
 
 @partial(jax.jit, donate_argnums=(0,))
@@ -329,40 +460,7 @@ class CompiledModel:
                 for w, s in layer.weight_specs.items()
             }
 
-        def init_fn(key):
-            from flexflow_tpu.core.tensor import TensorSpec
-
-            params = {}
-            for li, layer in enumerate(layers):
-                if not layer.weight_specs:
-                    continue
-                d = {}
-                for i, (wname, spec) in enumerate(sorted(layer.weight_specs.items())):
-                    # fork_join weights are "b{i}.{sublayer}.{wname}" (or
-                    # "stk.{sublayer}.{wname}" stacked): the default
-                    # initializer keys off the terminal wname
-                    # fold by topo position (not guid) so identically-built
-                    # models init identically across FFModel instances
-                    k = jax.random.fold_in(jax.random.fold_in(key, li), i)
-                    if wname.startswith("stk."):
-                        # stacked fork_join storage: init each branch slice
-                        # independently (fan-in/out from the SLICE shape, and
-                        # per-branch initializer overrides still apply)
-                        sspec = TensorSpec(spec.shape[1:], spec.dtype)
-                        default = default_initializer(wname.rsplit(".", 1)[-1])
-                        slices = []
-                        for b in range(spec.shape[0]):
-                            init = overrides.get(
-                                (layer.name, f"b{b}.{wname[4:]}")) or default
-                            slices.append(init(jax.random.fold_in(k, b), sspec))
-                        d[wname] = jnp.stack(slices)
-                    else:
-                        init = overrides.get((layer.name, wname)) or \
-                            default_initializer(wname.rsplit(".", 1)[-1])
-                        d[wname] = init(k, spec)
-                params[layer.name] = d
-            return params
-
+        init_fn = build_init_fn(layers, overrides)
         self.params = jax.jit(init_fn, out_shardings=shardings)(jax.random.PRNGKey(seed))
         self.state = {}
         # jitted with EXPLICIT out_shardings (vs the old eager tx.init):

@@ -97,6 +97,18 @@ class FFConfig:
     # dispatch) and the deferred-metrics loop. Microbatches beyond the last
     # full group of an epoch are dropped (drop_remainder semantics).
     accum_steps: int = 1
+    # pipeline parallelism (parallel/pipeline.py): split the layer graph
+    # into N sequential stages on DISJOINT device groups over a "pipe" mesh
+    # axis — each group holds only its stage's weights + optimizer state
+    # (per-device persistent memory divides by N, composing with
+    # --zero-sharding). accum_steps is the microbatch count M the schedule
+    # pipelines over; 1 < N requires accum_steps > 1 for any overlap.
+    #   pipeline_schedule: "gpipe" (all forwards, then all backwards; M
+    #   in-flight boundary activations per stage) or "1f1b" (one-forward-
+    #   one-backward steady state; <= N in-flight activations). Both have
+    #   bubble fraction (N-1)/(M+N-1); 1f1b's win is activation memory.
+    pipeline_stages: int = 1
+    pipeline_schedule: str = "1f1b"
     # execution
     enable_fusion: bool = True
     profiling: bool = False
@@ -126,19 +138,13 @@ class FFConfig:
         return len(jax.devices())
 
     @staticmethod
-    def parse_args(argv: Optional[List[str]] = None) -> "FFConfig":
-        # FF_LAUNCH_ARGS: machine config injected by the Jupyter kernelspec
-        # (flexflow_tpu/jupyter — the reference custom-kernel analog) or a
-        # launcher wrapper. Honored ONLY for real CLI invocations
-        # (argv=None): a kernelspec-installed env var must not silently
-        # alter explicit programmatic configs in tests/scripts (ADVICE r5).
-        # CLI flags still override the environment.
-        if argv is None:
-            import shlex
-            import sys
-
-            env_args = shlex.split(os.environ.get("FF_LAUNCH_ARGS", ""))
-            argv = env_args + list(sys.argv[1:])
+    def build_parser() -> argparse.ArgumentParser:
+        """The ONE FFConfig argument parser. The launcher's value-flag set
+        (launcher_value_flags) is derived from this parser's actions, so a
+        flag added here is automatically launcher-safe — PRs 2 and 3 both
+        had to hand-register their new flags in __main__.py, and the
+        regression class being guarded is `python -m flexflow_tpu
+        --new-flag VALUE train.py` treating VALUE as the script."""
         p = argparse.ArgumentParser("flexflow_tpu", allow_abbrev=False)
         p.add_argument("-e", "--epochs", type=int, default=1)
         p.add_argument("-b", "--batch-size", type=int, default=64)
@@ -182,6 +188,9 @@ class FFConfig:
         p.add_argument("--zero-sharding", type=str, default="off",
                        choices=("off", "zero1", "zero2"))
         p.add_argument("--accum-steps", type=int, default=1)
+        p.add_argument("--pipeline-stages", type=int, default=1)
+        p.add_argument("--pipeline-schedule", type=str, default="1f1b",
+                       choices=("gpipe", "1f1b"))
         p.add_argument("--fusion", dest="fusion", action="store_true", default=True)
         p.add_argument("--no-fusion", dest="fusion", action="store_false")
         p.add_argument("--profiling", action="store_true")
@@ -190,7 +199,38 @@ class FFConfig:
         p.add_argument("--remat", action="store_true")
         p.add_argument("--compgraph", dest="export_dot", type=str, default="")
         p.add_argument("--include-costs-dot-graph", action="store_true")
-        args, _unknown = p.parse_known_args(argv)
+        return p
+
+    @staticmethod
+    def launcher_value_flags() -> set:
+        """Option strings that CONSUME the next argv token — derived from
+        the parser instead of hand-maintained in __main__.py, so the
+        launcher's script-vs-flag-value split can never drift behind a
+        newly added flag. argparse encodes the distinction as nargs: flag
+        actions (store_true / BooleanOptionalAction / help) carry nargs=0,
+        value-taking ones nargs=None (one token) or an int/str spec."""
+        flags = set()
+        for a in FFConfig.build_parser()._actions:
+            if a.nargs == 0:
+                continue
+            flags.update(a.option_strings)
+        return flags
+
+    @staticmethod
+    def parse_args(argv: Optional[List[str]] = None) -> "FFConfig":
+        # FF_LAUNCH_ARGS: machine config injected by the Jupyter kernelspec
+        # (flexflow_tpu/jupyter — the reference custom-kernel analog) or a
+        # launcher wrapper. Honored ONLY for real CLI invocations
+        # (argv=None): a kernelspec-installed env var must not silently
+        # alter explicit programmatic configs in tests/scripts (ADVICE r5).
+        # CLI flags still override the environment.
+        if argv is None:
+            import shlex
+            import sys
+
+            env_args = shlex.split(os.environ.get("FF_LAUNCH_ARGS", ""))
+            argv = env_args + list(sys.argv[1:])
+        args, _unknown = FFConfig.build_parser().parse_known_args(argv)
 
         mesh: Dict[str, int] = {}
         if args.mesh:
@@ -233,6 +273,8 @@ class FFConfig:
             async_checkpoint=args.async_checkpoint,
             zero_sharding=args.zero_sharding,
             accum_steps=args.accum_steps,
+            pipeline_stages=args.pipeline_stages,
+            pipeline_schedule=args.pipeline_schedule,
             enable_fusion=args.fusion,
             profiling=args.profiling,
             profile_dir=args.profile_dir,

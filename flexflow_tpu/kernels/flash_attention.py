@@ -32,38 +32,58 @@ _NEG_INF = float("-inf")
 # on shapes that pass the divisibility checks. Longer sequences belong to the
 # ring-attention path (kernels/ring_attention.py).
 _VMEM_SEQ_BYTES = 6 * 1024 * 1024
+# per-BLOCK VMEM budget: the block-shape ceiling was implicitly sized for
+# head_dim 64 (a 512 x 64 f32 block = 128KB). Wider heads scale the block
+# footprint linearly, so the block choice is parametrized by (depth,
+# itemsize): head_dim 128 f32 drops 512 -> 256 instead of handing Mosaic a
+# 256KB block per operand (q, do, dq accumulators all carry it); bf16 keeps
+# the full 512. 160KB leaves the d=64 behavior exactly as before.
+_VMEM_BLOCK_BYTES = 160 * 1024
+
+
+def _blocks_for(depth: int, itemsize: int):
+    ok = tuple(b for b in _BLOCK_CANDIDATES
+               if b * max(1, depth) * itemsize <= _VMEM_BLOCK_BYTES)
+    # always leave the smallest block available: a 128-row block at any
+    # plausible head_dim fits VMEM; the budget only orders preferences
+    return ok or _BLOCK_CANDIDATES[-1:]
 
 
 def flash_supported(seq: int, depth: int, itemsize: int = 4) -> bool:
-    """Whether the fused kernel covers this shape (block divisibility +
-    the VMEM-resident k/v budget). Beyond it, attention either falls back
-    to materializing full logits or goes sequence-parallel via the ring
-    path — the search uses this to price that choice."""
-    if any(seq % b == 0 for b in _BLOCK_CANDIDATES):
+    """Whether the fused kernel covers this shape (depth-aware block
+    divisibility + the VMEM-resident k/v budget). Beyond it, attention
+    either falls back to materializing full logits or goes
+    sequence-parallel via the ring path — the search uses this to price
+    that choice."""
+    if any(seq % b == 0 for b in _blocks_for(depth, itemsize)):
         return 2 * seq * depth * itemsize <= _VMEM_SEQ_BYTES
     return False
 
 
-def _pick_block(s: int, env: str = "FLEXFLOW_FLASH_BLOCK") -> int:
+def _pick_block(s: int, depth: int = 64, itemsize: int = 4,
+                env: str = "FLEXFLOW_FLASH_BLOCK") -> int:
     import os
 
+    cands = _blocks_for(depth, itemsize)
     try:
         forced = int(os.environ.get(env, "0") or "0")
     except ValueError:
         forced = 0
-    # tuning override: only known-safe block sizes (VMEM budget was sized
-    # for _BLOCK_CANDIDATES; arbitrary values could OOM Mosaic)
-    if forced in _BLOCK_CANDIDATES and s % forced == 0:
+    # tuning override: only known-safe block sizes (the per-block VMEM
+    # budget was sized for _blocks_for's output; arbitrary values could
+    # OOM Mosaic)
+    if forced in cands and s % forced == 0:
         return forced
     if env != "FLEXFLOW_FLASH_BLOCK":
         # bwd knob unset OR invalid: inherit the main block choice (so a
         # typo'd bwd value degrades to the fwd configuration, not to a
         # third configuration nobody asked for)
-        return _pick_block(s)
-    for b in _BLOCK_CANDIDATES:
+        return _pick_block(s, depth, itemsize)
+    for b in cands:
         if s % b == 0:
             return b
-    raise ValueError(f"sequence length {s} not divisible by any of {_BLOCK_CANDIDATES}")
+    raise ValueError(f"sequence length {s} not divisible by any of {cands} "
+                     f"(head_dim {depth}, itemsize {itemsize})")
 
 
 def _interpret() -> bool:
@@ -73,9 +93,12 @@ def _interpret() -> bool:
 def _params():
     from jax.experimental.pallas import tpu as pltpu
 
-    # batch/head/q-block grid dims are independent; lets Mosaic pipeline them
-    return pltpu.CompilerParams(
-        dimension_semantics=("parallel", "parallel", "arbitrary"))
+    # batch/head/q-block grid dims are independent; lets Mosaic pipeline
+    # them. The class was renamed across jax releases (TPUCompilerParams
+    # -> CompilerParams); accept either spelling.
+    cls = getattr(pltpu, "CompilerParams", None) or \
+        getattr(pltpu, "TPUCompilerParams")
+    return cls(dimension_semantics=("parallel", "parallel", "arbitrary"))
 
 
 # --------------------------------------------------------------------- forward
@@ -122,8 +145,8 @@ def _fwd(q, k, v, causal, scale):
     """q: (b, h, sq, d); k/v: (b, h, sk, d) -> (o, lse)."""
     b, h, sq, d = q.shape
     sk = k.shape[2]
-    bq = _pick_block(sq)
-    bk = _pick_block(sk)
+    bq = _pick_block(sq, d, q.dtype.itemsize)
+    bk = _pick_block(sk, d, k.dtype.itemsize)
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal, block_k=bk)
     o, lse = pl.pallas_call(
         kernel,
@@ -229,8 +252,8 @@ def _bwd(causal, scale, res, g):
     # FLEXFLOW_FLASH_BLOCK_BWD tunes the backward independently (the dq /
     # dkv kernels have different VMEM/recompute balance than the forward);
     # unset = inherit FLEXFLOW_FLASH_BLOCK's choice
-    bq = _pick_block(sq, env="FLEXFLOW_FLASH_BLOCK_BWD")
-    bk = _pick_block(sk, env="FLEXFLOW_FLASH_BLOCK_BWD")
+    bq = _pick_block(sq, d, q.dtype.itemsize, env="FLEXFLOW_FLASH_BLOCK_BWD")
+    bk = _pick_block(sk, d, k.dtype.itemsize, env="FLEXFLOW_FLASH_BLOCK_BWD")
     do = g.astype(jnp.float32)
     delta = jnp.sum(do * o.astype(jnp.float32), axis=-1, keepdims=True)  # (b, h, sq, 1)
 
@@ -291,11 +314,16 @@ def flash_attention(q, k, v, causal: bool = False, scale: float | None = None):
                          f"(got {q.shape[2]} vs {k.shape[2]})")
     if k.shape[2] != v.shape[2]:
         raise ValueError(f"k/v length mismatch {k.shape} vs {v.shape}")
-    _pick_block(q.shape[2])
-    _pick_block(k.shape[2])
+    _pick_block(q.shape[2], q.shape[3], q.dtype.itemsize)
+    _pick_block(k.shape[2], k.shape[3], k.dtype.itemsize)
     for s_, d_, it in ((q.shape[2], q.shape[3], q.dtype.itemsize),
                       (k.shape[2], k.shape[3], k.dtype.itemsize)):
         if 2 * s_ * d_ * it > _VMEM_SEQ_BYTES:
+            # the Mosaic-reject precheck: shapes whose VMEM-resident
+            # operands can't fit raise HERE, at trace time, where the
+            # attention op's auto path catches ValueError and falls back
+            # to the einsum reference path (ops/attention_ops.py) instead
+            # of dying inside the backend compiler
             raise ValueError(
                 f"sequence {s_} x depth {d_} exceeds the VMEM-resident budget "
                 f"({_VMEM_SEQ_BYTES} bytes); use the einsum or ring path")

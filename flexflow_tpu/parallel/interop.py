@@ -58,11 +58,17 @@ except ImportError:  # pragma: no cover
 
 def _pvary(x, axes):
     """Mark x as varying over `axes` in the vma type system (pcast on new
-    jax; pvary on older)."""
+    jax; pvary on older; identity on jax predating varying-manual-axes
+    entirely — there the promotion is unnecessary because shard_map does
+    not type-check cotangent vma)."""
     try:
         return jax.lax.pcast(x, axes, to="varying")
-    except (AttributeError, TypeError):  # pragma: no cover
+    except (AttributeError, TypeError):
+        pass
+    try:
         return jax.lax.pvary(x, tuple(axes))
+    except AttributeError:  # pragma: no cover
+        return x
 
 
 def _batch_pspec(mesh: Mesh, axis: str, batch_len: int,

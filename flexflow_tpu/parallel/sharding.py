@@ -107,6 +107,12 @@ class Strategy:
     input_shardings: Dict[str, List[DimSharding]] = dataclasses.field(default_factory=dict)
     mesh_axes: Dict[str, int] = dataclasses.field(default_factory=dict)
     name: str = "strategy"
+    # inter-op (pipeline) dimension of the strategy: None, or
+    # {"stages": S, "cuts": [topo idx...], "schedule": "gpipe"|"1f1b"} —
+    # the op_shardings describe layouts WITHIN a stage (on the stage
+    # sub-mesh); this block says where the sequential splits fall
+    # (parallel/pipeline.py executes them on disjoint device groups)
+    pipeline: Optional[Dict] = None
 
     def input_pspec(self, tensor_name: str) -> PartitionSpec:
         if tensor_name not in self.input_shardings:
@@ -118,12 +124,15 @@ class Strategy:
 
     # ----------------------------------------------------------------- io
     def to_json(self) -> dict:
-        return {
+        d = {
             "name": self.name,
             "mesh_axes": self.mesh_axes,
             "inputs": self.input_shardings,
             "ops": {k: v.to_json() for k, v in self.op_shardings.items()},
         }
+        if self.pipeline:
+            d["pipeline"] = self.pipeline
+        return d
 
     def save(self, path: str):
         with open(path, "w") as f:
@@ -136,6 +145,7 @@ class Strategy:
             input_shardings={k: [_norm_dim(x) for x in v] for k, v in d.get("inputs", {}).items()},
             mesh_axes=dict(d.get("mesh_axes", {})),
             name=d.get("name", "strategy"),
+            pipeline=d.get("pipeline"),
         )
 
     @staticmethod

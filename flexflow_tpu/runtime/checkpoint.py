@@ -190,6 +190,113 @@ def save_checkpoint(cm, path: str, block: bool = True) -> str:
     return path
 
 
+def save_pipeline_checkpoint(pm, path: str, block: bool = True) -> str:
+    """Checkpoint a PipelinedModel (parallel/pipeline.py): params are saved
+    as ONE logical tree keyed by layer name (stage ownership is a placement
+    detail, not a schema detail), optimizer state per stage. Restoring onto
+    a different stage-internal mesh (e.g. data=4 -> data=2 per stage) is
+    the same global-array re-shard the flat path does; the stage COUNT must
+    match (the per-stage optax state trees key on it)."""
+    import orbax.checkpoint as ocp
+
+    path = _ckpt_dir(path)
+    wait_pending(path)
+    meta = {
+        "iteration": int(pm._iteration),
+        "strategy": pm.strategy.to_json(),
+        "mesh_axes": dict(pm.stage_machine.mesh_axes),
+        "pipeline": {"stages": pm.num_stages, "schedule": pm.schedule,
+                     "cuts": list(pm.cuts)},
+        "zero_sharding": getattr(pm.cfg, "zero_sharding", "off"),
+    }
+    tree = {"params": pm.merged_params(),
+            "opt_state": {f"stage{s}": pm.stage_opt[s]
+                          for s in range(pm.num_stages)}}
+    # non-trainable state merges like params: keys are "{layer.name}/..."
+    # so restore re-derives stage ownership from the layer-name prefix
+    state = {k: np.asarray(v) for d in pm.stage_state for k, v in d.items()}
+    ckptr = ocp.StandardCheckpointer()
+    if block or jax.process_count() > 1:
+        _write_tree(ckptr, path, tree, meta, state)
+        return path
+    host_tree = jax.tree_util.tree_map(np.asarray, tree)
+    _register_exit_drain()
+    handle = _AsyncSave(path)
+    with _PENDING_LOCK:
+        _PENDING[path] = handle
+    handle.start(lambda: _write_tree(ckptr, path, host_tree, meta, state))
+    return path
+
+
+def restore_pipeline_checkpoint(pm, path: str) -> None:
+    """Restore a pipeline checkpoint into a PipelinedModel built from the
+    same model graph, stage count and cuts. Each param lands on the stage
+    owning its layer, in the restoring stage-mesh's sharding — so a
+    checkpoint saved under {data: 4} stages restores onto {data: 2} stages
+    (cross-mesh re-shard of stage-sharded state). The cuts must match: the
+    per-stage optax state trees embed the stage's layer partition."""
+    import orbax.checkpoint as ocp
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    path = _ckpt_dir(path)
+    wait_pending(path)
+    if pm.stage_params[0] is None:
+        pm.init()
+    with open(os.path.join(path, "meta.json")) as f:
+        meta = json.load(f)
+    saved = meta.get("pipeline", {})
+    if saved.get("stages") != pm.num_stages:
+        raise ValueError(
+            f"checkpoint has {saved.get('stages')} pipeline stages, model "
+            f"has {pm.num_stages}: per-stage optimizer state cannot be "
+            "re-keyed across stage counts")
+    if sorted(saved.get("cuts", [])) != sorted(pm.cuts):
+        raise ValueError(
+            f"checkpoint stage cuts {saved.get('cuts')} != model cuts "
+            f"{list(pm.cuts)}: the per-stage optax state trees embed the "
+            "stage's layer partition (orbax would fail on the structure "
+            "mismatch anyway — failing cleanly here)")
+    if dict(meta.get("mesh_axes", {})) != dict(pm.stage_machine.mesh_axes):
+        logging.getLogger("flexflow_tpu").info(
+            "pipeline checkpoint %s saved on stage mesh %s, restoring "
+            "onto %s (re-shard)", path, meta.get("mesh_axes"),
+            dict(pm.stage_machine.mesh_axes))
+    ckptr = ocp.StandardCheckpointer()
+    target = {"params": pm.merged_params(),
+              "opt_state": {f"stage{s}": pm.stage_opt[s]
+                            for s in range(pm.num_stages)}}
+    restored = ckptr.restore(os.path.join(path, "tree"), target)
+
+    def _placed(r, t, mesh):
+        sh = getattr(t, "sharding", None)
+        if isinstance(sh, NamedSharding):
+            return jax.device_put(r, sh)
+        return jax.device_put(r, NamedSharding(mesh, PartitionSpec()))
+
+    for s in range(pm.num_stages):
+        live = pm.stage_params[s]
+        pm.stage_params[s] = jax.tree_util.tree_map(
+            lambda r, t, _m=pm.stage_meshes[s]: _placed(r, t, _m),
+            {ln: restored["params"][ln] for ln in live}, live)
+        pm.stage_opt[s] = jax.tree_util.tree_map(
+            lambda r, t, _m=pm.stage_meshes[s]: _placed(r, t, _m),
+            restored["opt_state"][f"stage{s}"], pm.stage_opt[s])
+    pm._iteration = int(meta.get("iteration", 0))
+    state_file = os.path.join(path, "state.npz")
+    if os.path.exists(state_file):
+        import jax.numpy as jnp
+
+        loaded = np.load(state_file)
+        owner = {l.name: s for s in range(pm.num_stages)
+                 for l in pm.stage_layers[s]}
+        for s in range(pm.num_stages):
+            pm.stage_state[s] = {}
+        for k in loaded.files:
+            s = owner.get(k.rsplit("/", 1)[0])
+            if s is not None:
+                pm.stage_state[s][k] = jnp.asarray(loaded[k])
+
+
 def restore_checkpoint(cm, path: str) -> None:
     """Restore `save_checkpoint` output into a CompiledModel built from the
     same model graph. Arrays land directly in the compiled shardings (the

@@ -207,6 +207,86 @@ def _best_groups(costs, n: int, b_local: int):
     return best
 
 
+def cut_boundary_tensor(layers, ci: int, last_use=None):
+    """THE tensor that crosses cut ci (cut after topo index ci): the cut
+    layer's output still consumed after ci. sequence_cut_indices only
+    guarantees the single live tensor is SOME output of the cut layer —
+    a multi-output layer whose first output dies early is a valid cut
+    point whose boundary is a LATER output, so callers must never assume
+    outputs[0]."""
+    if last_use is None:
+        last_use = {}
+        for li, l in enumerate(layers):
+            for t in l.inputs:
+                last_use[t.guid] = li
+    for o in layers[ci].outputs:
+        if last_use.get(o.guid, -1) > ci:
+            return o
+    return layers[ci].outputs[0]  # ci == last layer (not a real cut)
+
+
+def stage_cut_candidates(model, machine: MachineSpec, num_stages: int,
+                         max_candidates: int = 12) -> List[tuple]:
+    """Candidate stage partitions for pipeline parallelism: tuples of
+    (num_stages - 1) cut indices (cut AFTER topo position i), restricted to
+    single-tensor cut points (exactly one live tensor crosses the boundary
+    — the same find_split_node rule unity's sequence splitting uses, so a
+    stage boundary is always ONE activation transfer). Ranked by predicted
+    stage balance under the data-parallel placement (per-layer op_time
+    prefix sums on the STAGE machine) with the boundary-transfer bytes as
+    tiebreak; the top `max_candidates` go to the cut-point DP
+    (search/dp.py search_pipelined) for exact costing."""
+    import itertools
+
+    from flexflow_tpu.core.graph import topo_order
+    from flexflow_tpu.search.unity import sequence_cut_indices
+
+    layers = topo_order(model.layers)
+    cuts = sequence_cut_indices(layers, model.input_tensors)
+    if num_stages <= 1:
+        return [()]
+    if len(cuts) < num_stages - 1:
+        return []
+    batch_sizes = {t.shape[0] for t in model.input_tensors if t.ndim > 0}
+    t_layer = []
+    for layer in layers:
+        cands = layer_candidates(layer, machine, batch_sizes)
+        t_layer.append(cands[0].op_time(layer, machine)
+                       if not cands[0].passthrough else 0.0)
+    prefix = [0.0]
+    for t in t_layer:
+        prefix.append(prefix[-1] + t)
+
+    last_use: Dict[int, int] = {}
+    for li, l in enumerate(layers):
+        for t in l.inputs:
+            last_use[t.guid] = li
+
+    # boundary activation bytes per cut point (the single live tensor)
+    def _cut_bytes(ci: int) -> int:
+        return cut_boundary_tensor(layers, ci, last_use).spec.size_bytes
+
+    # keep the combination count bounded on deep models: thin the cut list
+    # to ~24 points evenly spaced in cumulative cost before enumerating
+    if len(cuts) > 24:
+        want = [prefix[-1] * (k + 1) / 25.0 for k in range(24)]
+        thinned, wi = [], 0
+        for ci in cuts:
+            if wi < len(want) and prefix[ci + 1] >= want[wi]:
+                thinned.append(ci)
+                wi += 1
+        cuts = thinned or cuts[:24]
+
+    def _rank(combo) -> tuple:
+        bounds = [-1] + list(combo) + [len(layers) - 1]
+        seg = [prefix[bounds[i + 1] + 1] - prefix[bounds[i] + 1]
+               for i in range(num_stages)]
+        return (max(seg), sum(_cut_bytes(c) for c in combo))
+
+    ranked = sorted(itertools.combinations(cuts, num_stages - 1), key=_rank)
+    return [tuple(c) for c in ranked[:max_candidates]]
+
+
 def layer_candidates(layer: "Layer", machine: MachineSpec, batch_sizes,
                      enable_parameter: bool = True,
                      enable_attribute: bool = True) -> List[Candidate]:

@@ -389,3 +389,219 @@ def search_graph(model, machine: MachineSpec, beam_width: int = 64,
         # layout — different layouts are materially different strategies
         return [_to_result(e) for e in ranked[:topk]]
     return _to_result(ranked[0])
+
+
+# ------------------------------------------------------- pipeline search
+@dataclasses.dataclass
+class PipelineSearchResult:
+    """One costed inter-op (pipeline) strategy: where to cut, how to
+    schedule, and what it is predicted to cost — comparable against the
+    non-pipelined SearchResult through `score` (same _score rule the
+    frontier DP ranks by, so the memory penalty speaks the same units)."""
+
+    stages: int
+    cuts: Tuple[int, ...]          # topo indices: cut AFTER layers[i]
+    schedule: str                  # "gpipe" | "1f1b" ("none" when stages=1)
+    cost: float                    # predicted time for ONE update (M microbatches)
+    mem_bytes: int                 # per-device high-water of the WORST stage
+    bubble: float                  # predicted bubble fraction of the schedule
+    score: float                   # _score(cost, mem_bytes, mem_budget)
+    stage_costs: List[float] = dataclasses.field(default_factory=list)
+    choices: Optional[Dict[str, Candidate]] = None  # merged per-stage layouts
+
+
+def stage_machine_for(machine: MachineSpec, num_stages: int) -> MachineSpec:
+    """The machine ONE pipeline stage runs on: the full machine with the
+    pipe dimension factored out. An explicit "pipe" axis is dropped (its
+    degree must equal num_stages); otherwise the batch ("data") axis degree
+    divides by num_stages — stages claim whole device groups, the groups
+    keep data-parallelism inside."""
+    axes = dict(machine.mesh_axes)
+    if "pipe" in axes:
+        if axes["pipe"] != num_stages:
+            raise ValueError(f"mesh pipe={axes['pipe']} != "
+                             f"--pipeline-stages {num_stages}")
+        axes.pop("pipe")
+    else:
+        from flexflow_tpu.search.candidates import _batch_axes
+
+        ba = next(iter(_batch_axes(machine)), None)
+        if ba is None or axes.get(ba, 1) % num_stages != 0:
+            raise ValueError(
+                f"cannot split {num_stages} pipeline stages out of mesh "
+                f"{axes}: no batch axis with degree divisible by "
+                f"{num_stages} (add pipe={num_stages} to --mesh)")
+        axes[ba] //= num_stages
+        if axes[ba] == 1 and len(axes) > 1:
+            axes.pop(ba)
+    if not axes:
+        axes = {"data": 1}
+    return MachineSpec(mesh_axes=axes, chip=machine.chip,
+                       flops=machine.flops, hbm_bw=machine.hbm_bw,
+                       hbm_bytes=machine.hbm_bytes,
+                       ici_bw=dict(machine.ici_bw),
+                       dcn_axes=tuple(a for a in machine.dcn_axes
+                                      if a in axes),
+                       dcn_bw=machine.dcn_bw,
+                       mxu_flop_overhead=machine.mxu_flop_overhead,
+                       mxu_min_dim=machine.mxu_min_dim,
+                       axis_type=dict(machine.axis_type),
+                       overlap_frac=machine.overlap_frac)
+
+
+def search_pipelined(model, machine: MachineSpec, num_stages: int,
+                     microbatches: int, schedule: str = "1f1b",
+                     mem_budget: Optional[float] = None,
+                     beam_width: int = 16, cost_fn=None,
+                     enable_parameter: bool = True,
+                     enable_attribute: bool = True,
+                     opt_mem: "Optional[cm.OptMemSpec]" = None,
+                     max_candidates: int = 12,
+                     ) -> Optional[PipelineSearchResult]:
+    """Search over stage cut points (the reference's sequential inter-op
+    splits, graph.cc sequence enumeration; JaxPP's stage assignment): each
+    candidate cut tuple (search/candidates.stage_cut_candidates) is costed
+    by running the frontier DP per stage SUB-GRAPH on the stage machine
+    (layouts inside a stage compose freely with the pipeline split), then
+    the schedule's event-driven replay prices the whole update:
+
+      cost  = pipeline_step_time(per-stage fwd/bwd, boundary P2P, M)
+      mem   = worst stage's weight high-water + the schedule's in-flight
+              boundary stash (M for gpipe, min(S, M) for 1f1b) — per-stage
+              weights divide ~S x, which is what lets a memory-capped
+              search pick pipelining when pure data parallelism can't fit.
+
+    Returns the best PipelineSearchResult, or None when the graph has too
+    few single-tensor cut points for `num_stages`."""
+    from flexflow_tpu.search.candidates import stage_cut_candidates
+    from flexflow_tpu.search.pcg import PCG
+
+    if num_stages <= 1:
+        raise ValueError("search_pipelined needs num_stages > 1")
+    smach = stage_machine_for(machine, num_stages)
+    mem_budget = mem_budget or machine.hbm_bytes
+    layers = topo_order(model.layers)
+    combos = stage_cut_candidates(model, smach, num_stages,
+                                  max_candidates=max_candidates)
+    if not combos:
+        return None
+    inflight = cm.pipeline_inflight_acts(schedule, num_stages, microbatches)
+    best: Optional[PipelineSearchResult] = None
+    for cuts in combos:
+        bounds = [-1] + list(cuts) + [len(layers) - 1]
+        stage_results: List[SearchResult] = []
+        boundary_bytes: List[int] = []
+        feasible = True
+        for si in range(num_stages):
+            seg = layers[bounds[si] + 1:bounds[si + 1] + 1]
+            internal = {o.guid for l in seg for o in l.outputs}
+            ext, seen = [], set()
+            for l in seg:
+                for t in l.inputs:
+                    if t.guid not in internal and t.guid not in seen:
+                        seen.add(t.guid)
+                        ext.append(t)
+            try:
+                r = search_graph(PCG.from_layers(seg, ext), smach,
+                                 beam_width=beam_width,
+                                 mem_budget=mem_budget, cost_fn=cost_fn,
+                                 enable_parameter=enable_parameter,
+                                 enable_attribute=enable_attribute,
+                                 opt_mem=opt_mem)
+            except (KeyError, RuntimeError):
+                feasible = False
+                break
+            stage_results.append(r)
+        if not feasible:
+            continue
+        from flexflow_tpu.search.candidates import cut_boundary_tensor
+
+        for ci in cuts:
+            bt = cut_boundary_tensor(layers, ci)
+            boundary_bytes.append(
+                cm.shard_bytes(bt.spec,
+                               _dp_dims_for(bt.spec.shape, smach, model),
+                               smach))
+        # phase split matching the executor (cost_model
+        # .pipeline_phase_times): fwd c/3, bwd a FULL c (recompute-based),
+        # last stage's fwd fused into its backward
+        fwd, bwd = cm.pipeline_phase_times([r.cost for r in stage_results])
+        cost = cm.pipeline_step_time(fwd, bwd, boundary_bytes, machine,
+                                     schedule, microbatches)
+        bubble = cm.pipeline_bubble(schedule, microbatches, fwd, bwd)
+        # per-device memory of stage si: its own weights + live acts, plus
+        # the schedule's stashed boundary inputs (value + recompute grad)
+        mems = []
+        for si, r in enumerate(stage_results):
+            stash = 0
+            if si > 0:
+                stash = 2 * inflight * boundary_bytes[si - 1]
+            mems.append(r.mem_bytes + stash)
+        mem = max(mems)
+        score = _score(cost, mem, mem_budget)
+        if best is None or score < best.score:
+            merged: Dict[str, Candidate] = {}
+            for r in stage_results:
+                merged.update(r.choices)
+            best = PipelineSearchResult(
+                stages=num_stages, cuts=tuple(cuts), schedule=schedule,
+                cost=cost, mem_bytes=mem, bubble=bubble, score=score,
+                stage_costs=[r.cost for r in stage_results],
+                choices=merged)
+    if best is not None:
+        # event-replay validation of the winning schedule: the simulator
+        # re-times the tick grid and must agree with the cost above
+        from flexflow_tpu.search.simulator import simulate_pipeline
+
+        vf, vb = cm.pipeline_phase_times(best.stage_costs)
+        rep = simulate_pipeline(vf, vb, best.schedule, microbatches)
+        best.bubble = rep["bubble"]
+    return best
+
+
+def _dp_dims_for(shape, machine: MachineSpec, model):
+    from flexflow_tpu.search.candidates import _dp_dims
+
+    batch_sizes = {t.shape[0] for t in model.input_tensors if t.ndim > 0}
+    return _dp_dims(shape, machine, batch_sizes)
+
+
+def choose_pipeline(model, machine: MachineSpec, microbatches: int,
+                    stages_options: Sequence[int] = (1, 2, 4),
+                    schedule: str = "1f1b",
+                    mem_budget: Optional[float] = None,
+                    beam_width: int = 16,
+                    opt_mem: "Optional[cm.OptMemSpec]" = None,
+                    ) -> "PipelineSearchResult":
+    """Pick the best of {non-pipelined, pipelined at each S} under the
+    SAME _score rule (cost x quadratic over-HBM penalty). The non-pipelined
+    entry is the plain frontier DP on the full machine, its cost scaled to
+    the same unit (M microbatches = one update); pipelining wins exactly
+    when the memory cap makes replicating every stage's weights on every
+    device infeasible and the bubble costs less than the penalty — the
+    MULTICHIP-style assertion tests/test_pipeline.py makes."""
+    mem_budget = mem_budget or machine.hbm_bytes
+    results: List[PipelineSearchResult] = []
+    for s in stages_options:
+        if s <= 1:
+            r0 = search_graph(model, machine, beam_width=beam_width,
+                              mem_budget=mem_budget, opt_mem=opt_mem)
+            results.append(PipelineSearchResult(
+                stages=1, cuts=(), schedule="none",
+                cost=microbatches * r0.cost, mem_bytes=r0.mem_bytes,
+                bubble=0.0,
+                score=_score(microbatches * r0.cost, r0.mem_bytes,
+                             mem_budget),
+                stage_costs=[r0.cost], choices=r0.choices))
+            continue
+        try:
+            r = search_pipelined(model, machine, s, microbatches,
+                                 schedule=schedule, mem_budget=mem_budget,
+                                 beam_width=beam_width, opt_mem=opt_mem)
+        except ValueError:
+            r = None
+        if r is not None:
+            results.append(r)
+    if not results:
+        raise RuntimeError("no feasible parallelization found")
+    return min(results, key=lambda r: r.score)

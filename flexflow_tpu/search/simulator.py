@@ -367,3 +367,43 @@ def rerank(model, machine: MachineSpec, results: Sequence,
                for r in results]
     best = min(range(len(results)), key=lambda i: reports[i].makespan)
     return results[best], reports
+
+
+# ----------------------------------------------------- pipeline validation
+def simulate_pipeline(fwd_times: Sequence[float], bwd_times: Sequence[float],
+                      schedule: str, num_micro: int,
+                      p2p: float = 0.0) -> dict:
+    """Event-driven replay of a pipeline schedule (the per-STAGE analog of
+    replay()'s per-stream timelines): each stage is one serial resource,
+    ops start at max(stage free, producer finish + p2p). Validates the
+    schedule the cut-point search chose — every dependency edge is checked
+    against the replayed event times (a schedule bug would surface as a
+    consumer starting before its producer finished) — and returns the
+    makespan / bubble the bench compares measured numbers against.
+
+    Returns {"makespan", "bubble", "events"} with events keyed
+    (phase, stage, microbatch) -> (start, end)."""
+    span, events = cm.pipeline_timeline(schedule, num_micro,
+                                        list(fwd_times), list(bwd_times),
+                                        p2p=p2p)
+    S = len(fwd_times)
+    for (ph, s, m), (start, _end) in events.items():
+        deps = []
+        if ph == "F" and s > 0:
+            deps.append(("F", s - 1, m))
+        if ph == "B":
+            deps.append(("F", s, m))
+            if s < S - 1:
+                deps.append(("B", s + 1, m))
+        for d in deps:
+            if events[d][1] > start + 1e-12:
+                raise RuntimeError(
+                    f"invalid pipeline schedule: {ph}(s={s}, m={m}) starts "
+                    f"at {start} before its producer {d} ends at "
+                    f"{events[d][1]}")
+    return {
+        "makespan": span,
+        "bubble": cm.pipeline_bubble(schedule, num_micro, list(fwd_times),
+                                     list(bwd_times), p2p=p2p),
+        "events": events,
+    }

@@ -112,7 +112,18 @@ def knob_fingerprint(cfg) -> str:
              cfg.base_optimize_threshold, cfg.memory_search, sub,
              cfg.simulator_mode, cfg.simulator_topk,
              cfg.simulator_segment_size,
-             getattr(cfg, "zero_sharding", "off"))
+             getattr(cfg, "zero_sharding", "off"),
+             # the pipeline dimension changes both the searched machine
+             # (stage sub-mesh) and the artifact (Strategy.pipeline): a
+             # different stage count / schedule / microbatch width must
+             # never hit a strategy searched for another
+             getattr(cfg, "pipeline_stages", 1),
+             getattr(cfg, "pipeline_schedule", "1f1b"),
+             # the microbatch count M prices the bubble the cut-point
+             # search ranks by — but only the pipelined search reads it, so
+             # plain compiles keep their cache hits across accum changes
+             (getattr(cfg, "accum_steps", 1)
+              if getattr(cfg, "pipeline_stages", 1) > 1 else 1))
     return hashlib.sha256(repr(knobs).encode()).hexdigest()[:16]
 
 

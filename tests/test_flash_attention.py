@@ -105,3 +105,66 @@ def test_mha_layer_uses_flash():
         cm.init(seed=0)
         outs[impl] = np.asarray(cm.forward(x))
     np.testing.assert_allclose(outs["flash"], outs["xla"], atol=2e-4, rtol=2e-4)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_head_dim_128_parity(causal):
+    """Satellite (round-5 MFU note): the block-shape ceiling was sized for
+    head_dim 64 — head_dim 128 must pick a depth-aware block (512-row f32
+    blocks would double the per-operand VMEM footprint) and still match
+    the einsum reference in fwd AND grads."""
+    from flexflow_tpu.kernels.flash_attention import _pick_block
+
+    # f32 head_dim 128 drops the 512 block; bf16 keeps it; d=64 unchanged
+    assert _pick_block(512, 64, 4) == 512
+    assert _pick_block(512, 128, 4) == 256
+    assert _pick_block(512, 128, 2) == 512
+
+    rng = np.random.default_rng(5)
+    b, h, s, d = 1, 2, 256, 128
+    q = jnp.asarray(rng.normal(size=(b, h, s, d)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(b, h, s, d)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(b, h, s, d)), jnp.float32)
+    scale = 1.0 / np.sqrt(d)
+    out = flash_attention(q, k, v, causal=causal)
+    ref = _reference(q, k, v, causal, scale)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=5e-5, rtol=5e-5)
+
+    def f_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=causal) ** 2)
+
+    def f_ref(q, k, v):
+        return jnp.sum(_reference(q, k, v, causal, scale) ** 2)
+
+    g_flash = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(g_flash, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   atol=1e-3, rtol=1e-3)
+
+
+def test_vmem_reject_falls_back_to_reference_path():
+    """A shape past the VMEM-resident budget raises ValueError at TRACE
+    time (the graceful Mosaic-reject precheck), and the MHA auto path
+    swallows it — the layer still lowers, via the einsum reference."""
+    from flexflow_tpu import FFConfig, FFModel
+    from flexflow_tpu.kernels.flash_attention import flash_supported
+
+    # seq * depth past the k/v-resident budget: supported == False and the
+    # kernel refuses up front
+    assert not flash_supported(8192, 128, 4)
+    q = jnp.zeros((1, 1, 8192, 128), jnp.float32)
+    with pytest.raises(ValueError):
+        flash_attention(q, q, q)
+
+    # auto mode: the same shape inside an MHA layer falls back silently
+    cfg = FFConfig(batch_size=1)
+    m = FFModel(cfg)
+    t = m.create_tensor((1, 8192, 128), name="x")
+    m.multihead_attention(t, t, t, embed_dim=128, num_heads=1,
+                          causal=True, name="attn")
+    cm = m.compile(loss_type="mean_squared_error")
+    cm.init(seed=0)
+    out = cm.forward(np.zeros((1, 8192, 128), np.float32))
+    assert np.asarray(out).shape == (1, 8192, 128)

@@ -3,6 +3,7 @@ tests/python_interface_test.sh). BASELINE config #1 done-criterion: the
 func_cifar10_alexnet-equivalent script runs end-to-end."""
 
 import numpy as np
+import pytest
 
 import flexflow_tpu.keras.optimizers as opt
 from flexflow_tpu.keras.callbacks import EpochVerifyMetrics
@@ -43,6 +44,8 @@ def test_functional_cnn_trains():
     assert "accuracy" in ev
 
 
+@pytest.mark.slow  # ~43s: full AlexNet example; the functional-CNN and
+# sequential tests cover the keras frontend in tier-1
 def test_alexnet_example_builds_and_runs():
     """The BASELINE #1 script at reduced sample count."""
     import importlib.util

@@ -183,6 +183,8 @@ def test_resnext50_shapes():
     assert g.weight_specs["kernel"].shape == (128, 4, 3, 3)
 
 
+@pytest.mark.slow  # ~21s: grouped-conv search e2e; resnet/alexnet train
+# tests keep the conv model-zoo coverage in tier-1
 def test_resnext_trains_and_searches(devices):
     """Scaled-down ResNeXt: grouped convs run the search (incl. the
     attribute-parallel conv path) and train e2e on the mesh."""

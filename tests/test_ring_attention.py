@@ -142,6 +142,8 @@ def test_ring_bwd_residuals_linear_in_seq(devices):
     assert b1024 <= 1.5 * expect, (b1024, expect)
 
 
+@pytest.mark.slow  # ~270s: the long-context capability demo; tier-1
+# keeps test_long_context_trains_seq_sharded as the ring e2e coverage
 def test_ring_32k_seq_trains_within_hbm(devices):
     """32k-sequence training through the ring path: grad step executes on
     the 8-device CPU mesh, and the residual accounting extrapolated to the
