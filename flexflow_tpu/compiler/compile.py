@@ -26,6 +26,7 @@ import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+from flexflow_tpu import telemetry as tel
 from flexflow_tpu.core.graph import topo_order
 from flexflow_tpu.core.tensor import Tensor
 from flexflow_tpu.compiler.lowering import build_forward, constrainable
@@ -81,7 +82,9 @@ def _pick_strategy(model, machine: MachineSpec, optimizer=None) -> Strategy:
         else:
             # the optimizer rides along so the search's memory model can
             # price its moments (count/state_dtype/ZeRO divisor) honestly
-            return graph_optimize(model, sm, optimizer=optimizer)
+            with tel.span("compile/graph_optimize", cat="compile",
+                          mesh=str(dict(sm.mesh_axes))):
+                return graph_optimize(model, sm, optimizer=optimizer)
     return data_parallel_strategy(model, machine)
 
 
@@ -110,6 +113,18 @@ def _overlay_parallel_ops(model, strategy: Strategy):
 
 def compile_model(model, optimizer, loss_type: LossType, metrics: Sequence[MetricsType],
                   outputs: Optional[Sequence[Tensor]] = None) -> "CompiledModel":
+    cfg = model.config
+    # --telemetry-dir enables the process-global span stream; "" leaves
+    # the current state untouched (disabling is an explicit
+    # telemetry.shutdown(), never a side effect of a later compile)
+    if getattr(cfg, "telemetry_dir", ""):
+        tel.configure(cfg.telemetry_dir)
+    with tel.span("compile/compile_model", cat="compile",
+                  pipeline_stages=int(cfg.pipeline_stages)):
+        return _compile_model(model, optimizer, loss_type, metrics, outputs)
+
+
+def _compile_model(model, optimizer, loss_type, metrics, outputs):
     cfg = model.config
     if cfg.machine_model_file:
         machine = MachineSpec.from_file(cfg.machine_model_file)
@@ -188,10 +203,15 @@ def _compile_pipelined(model, machine: MachineSpec, optimizer,
         if cfg.search_budget > 0 and not cfg.only_data_parallel:
             from flexflow_tpu.search import cost_model as cmod
 
-            r = search_pipelined(
-                model, machine, S, micro, schedule=cfg.pipeline_schedule,
-                mem_budget=machine.hbm_bytes if cfg.memory_search else None,
-                opt_mem=cmod.opt_mem_spec(optimizer, cfg, stage_machine))
+            with tel.span("compile/pipeline_cut_search", cat="compile",
+                          stages=S, micro=micro):
+                r = search_pipelined(
+                    model, machine, S, micro,
+                    schedule=cfg.pipeline_schedule,
+                    mem_budget=machine.hbm_bytes if cfg.memory_search
+                    else None,
+                    opt_mem=cmod.opt_mem_spec(optimizer, cfg,
+                                              stage_machine))
             if r is not None:
                 cuts = list(r.cuts)
                 logging.getLogger("flexflow_tpu").info(
@@ -341,6 +361,10 @@ class CompiledModel:
         # async-pipeline observability, rewritten by each fit (_fit_epochs):
         # dispatches / host_syncs / barriers / fused_steps
         self.step_stats: Dict[str, int] = {}
+        # drift-monitor windows from the LAST fit: [(steps, wall_seconds)]
+        # per epoch — drift_stats() medians these against the strategy's
+        # predicted step time
+        self._drift_windows: List[tuple] = []
 
         self.forward_fn = build_forward(model.layers, model.input_tensors, outputs,
                                         mesh, strategy,
@@ -721,6 +745,7 @@ class CompiledModel:
         in_sh = [self.input_sharding(t) for t in self.model.input_tensors]
         lab_sh = self.label_sharding((batch_size,) + tuple(np.asarray(y).shape[1:]))
         base_rng = jax.random.PRNGKey(self.cfg.seed + 17)
+        self._drift_windows = []  # this fit's drift-monitor windows
         history = []
         # --profiling (reference config.h:126): capture an xplane trace of
         # the whole fit (the Legion-trace/profiler analog, flexflow_c.cc:1747)
@@ -743,11 +768,22 @@ class CompiledModel:
                 if verbose:
                     print(f"[profiling] trace written to "
                           f"{self.cfg.profile_dir or './ff_profile'}")
+        self._fit_end_report(verbose)
         # per-op table only on the success path (it launches measurement
         # jits; on an error path it would mask the real exception)
         if prof_ctx is not None and verbose:
             self.profile_report()
         return history
+
+    def _fit_end_report(self, verbose: bool) -> None:
+        """Fit-end summary hooks: emit the drift event into the telemetry
+        stream, warn when the cost model has drifted past the threshold,
+        and surface any FAILED async checkpoint writes (a dropped
+        checkpoint must never go unnoticed — satellite of ISSUE 5)."""
+        from flexflow_tpu.runtime.checkpoint import warn_failed_writes
+
+        tel.emit_fit_end(self.drift_stats(), verbose)
+        warn_failed_writes(verbose)
 
     def _fit_epochs(self, epochs, loader, in_sh, lab_sh, base_rng,
                     batch_size, callbacks, verbose, sync_every,
@@ -792,6 +828,14 @@ class CompiledModel:
                                  PartitionSpec(None, *lab_sh_u.spec))
         stats = self.step_stats = {"dispatches": 0, "host_syncs": 0,
                                    "barriers": 0, "fused_steps": 0}
+        # telemetry + xplane step labels: `rec` is captured once (a local
+        # bool) so the disabled path stays the exact PR-2 loop — same
+        # dispatches, same host syncs, no per-step allocations beyond it.
+        # Under --profiling each dispatch also runs inside a
+        # StepTraceAnnotation, so the xplane trace is step-labeled.
+        rec = tel.enabled()
+        prof = jax.profiler.StepTraceAnnotation if self.cfg.profiling \
+            else None
         for epoch in range(epochs):
             # fallbacks re-evaluated per epoch: a recompile trigger
             # registered mid-fit (e.g. by on_epoch_end) must drop the loop
@@ -813,40 +857,72 @@ class CompiledModel:
             nb = 0
             ep_disp = ep_sync = 0
             since_sync = 0
-            for kind, dx, dy in prefetch_multi(
-                    group_microbatches(loader.epoch(), accum), k,
-                    in_sh_u, lab_sh_u, in_sh_k, lab_sh_k,
-                    put=self._put):
-                if kind == "k":
-                    (self.params, self.opt_state, self.state, loss,
-                     mvals) = multi(self.params, self.opt_state, self.state,
-                                    dx, dy, base_rng,
-                                    jnp.int32(self._iteration))
-                    steps = k
-                    stats["fused_steps"] += k
-                else:  # single step (k==1, or the tail of a fused epoch)
-                    rng = jax.random.fold_in(base_rng, self._iteration)
-                    (self.params, self.opt_state, self.state, loss,
-                     mvals) = self.train_step(self.params, self.opt_state,
-                                              self.state, dx, dy, rng)
-                    steps = 1
+            gen = prefetch_multi(
+                group_microbatches(loader.epoch(), accum), k,
+                in_sh_u, lab_sh_u, in_sh_k, lab_sh_k,
+                put=self._put)
+            while True:
+                # telemetry: the gap between "want next batch" and
+                # "prefetcher delivered" is the data-wait cost the async
+                # loop is supposed to hide
+                if rec:
+                    t_w = tel.now_us()
+                    item = next(gen, None)
+                    tel.record("fit/prefetch_wait", t_w, cat="fit")
+                else:
+                    item = next(gen, None)
+                if item is None:
+                    break
+                kind, dx, dy = item
+                if rec:
+                    t_d = tel.now_us()
+                ann = prof("train", step_num=self._iteration) \
+                    if prof is not None else tel.NULL_SPAN
+                with ann:
+                    if kind == "k":
+                        (self.params, self.opt_state, self.state, loss,
+                         mvals) = multi(self.params, self.opt_state,
+                                        self.state, dx, dy, base_rng,
+                                        jnp.int32(self._iteration))
+                        steps = k
+                        stats["fused_steps"] += k
+                    else:  # single step (k==1, or the fused-epoch tail)
+                        rng = jax.random.fold_in(base_rng, self._iteration)
+                        (self.params, self.opt_state, self.state, loss,
+                         mvals) = self.train_step(self.params,
+                                                  self.opt_state,
+                                                  self.state, dx, dy, rng)
+                        steps = 1
                 self._iteration += steps
                 nb += steps
                 since_sync += steps
                 ep_disp += 1
                 stats["dispatches"] += 1
+                if rec:
+                    tel.record("fit/dispatch", t_d, cat="fit", kind=kind,
+                               steps=steps, iteration=self._iteration)
                 pml.update_deferred(steps, {"loss": loss})
                 pm.update_deferred(batch_size * accum * steps, mvals)
                 if sync and since_sync >= sync:
+                    if rec:
+                        t_s = tel.now_us()
                     pml.materialize()
                     pm.materialize()
+                    if rec:
+                        tel.record("fit/host_sync", t_s, cat="fit",
+                                   iteration=self._iteration)
                     stats["host_syncs"] += 1
                     ep_sync += 1
                     since_sync = 0
                 elif ep_disp % ahead == 0:
                     # bounded dispatch-ahead: wait for the device to catch
                     # up (no host transfer, just a queue-depth barrier)
+                    if rec:
+                        t_b = tel.now_us()
                     jax.block_until_ready(loss)
+                    if rec:
+                        tel.record("fit/barrier_sync", t_b, cat="fit",
+                                   iteration=self._iteration)
                     stats["barriers"] += 1
                 for cb in per_batch_cbs:
                     cb.on_batch_end(self._iteration, {"loss": float(loss)})
@@ -854,8 +930,17 @@ class CompiledModel:
                     self._maybe_recompile()
             # epoch end: the one unavoidable materialization (not counted
             # as a mid-epoch host sync)
+            if rec:
+                t_s = tel.now_us()
             pml.materialize()
+            if rec:
+                tel.record("fit/host_sync", t_s, cat="fit",
+                           scope="epoch_end")
             dt = time.perf_counter() - t0
+            self._drift_windows.append((nb, dt))
+            if rec:
+                tel.record("fit/epoch", tel.now_us() - dt * 1e6,
+                           cat="fit", epoch=epoch, steps=nb)
             summ = pm.summary()
             summ["loss"] = pml.sums.get("loss", 0.0) / max(1, nb)
             summ["epoch_time_s"] = dt
@@ -1008,6 +1093,36 @@ class CompiledModel:
             "dp": dict(SEARCH_STATS),
         }
 
+    def predicted_step_time(self) -> Optional[float]:
+        """The cost model's per-UPDATE time prediction for this compile:
+        the search's own best_cost when the strategy came out of
+        graph_optimize (stamped there, and restored from the cache entry's
+        meta on warm hits), else the analytic additive sum over the
+        compiled candidates (data-parallel / imported strategies). Scaled
+        by accum_steps — one fit-loop step is one update over N
+        microbatch passes — so it is directly comparable to the drift
+        monitor's measured windows."""
+        accum = max(1, int(self._accum_steps))
+        pc = getattr(self.strategy, "_predicted_cost", None)
+        if pc:
+            return float(pc) * accum
+        try:
+            total = 0.0
+            for layer in self.model.layers:
+                cand = self._candidate_for(layer)
+                if not cand.passthrough:
+                    total += cand.op_time(layer, self.machine)
+            return total * accum if total > 0 else None
+        except Exception:
+            return None
+
+    def drift_stats(self) -> dict:
+        """Cost-model drift monitor: predicted vs measured step time (see
+        telemetry.drift_stats; windows are the last fit's per-epoch
+        (steps, seconds) pairs)."""
+        return tel.drift_stats(self.predicted_step_time(),
+                               list(self._drift_windows))
+
     def profile_report(self, top: int = 0, print_table: bool = True):
         """Per-op timing table (reference: per-kernel ms prints behind
         --profiling, src/ops/kernels/linear_kernels.cu:98-117): each layer's
@@ -1069,6 +1184,13 @@ class CompiledModel:
                   f"{mem['actual_param_bytes_per_device'] / mb:.2f}MB, "
                   f"opt state "
                   f"{mem['actual_opt_state_bytes_per_device'] / mb:.2f}MB")
+            for line in tel.format_drift(self.drift_stats()):
+                print(line)
+            from flexflow_tpu.runtime.checkpoint import \
+                report_failed_writes
+
+            for line in report_failed_writes():
+                print(line)
         return rows
 
     def export_sim_trace(self, path: str):

@@ -118,6 +118,11 @@ class FFConfig:
     remat: bool = False  # jax.checkpoint the forward for memory
     donate_state: bool = True
     # observability
+    # unified telemetry (flexflow_tpu/telemetry.py): span/counter JSONL
+    # stream across compile, fit, pipeline executor, dataloader prefetch
+    # and async checkpointing, rendered by tools/trace_report.py into a
+    # span summary + Chrome trace. "" = disabled (near-zero overhead).
+    telemetry_dir: str = ""
     export_dot: str = ""  # --compgraph analog
     include_costs_dot_graph: bool = False
     # chrome-trace export of the COMPILED strategy's event-driven replay
@@ -195,6 +200,7 @@ class FFConfig:
         p.add_argument("--no-fusion", dest="fusion", action="store_false")
         p.add_argument("--profiling", action="store_true")
         p.add_argument("--profile-dir", type=str, default="")
+        p.add_argument("--telemetry-dir", type=str, default="")
         p.add_argument("--compute-dtype", type=str, default="float32")
         p.add_argument("--remat", action="store_true")
         p.add_argument("--compgraph", dest="export_dot", type=str, default="")
@@ -278,6 +284,7 @@ class FFConfig:
             enable_fusion=args.fusion,
             profiling=args.profiling,
             profile_dir=args.profile_dir,
+            telemetry_dir=args.telemetry_dir,
             compute_dtype=args.compute_dtype,
             remat=args.remat,
             export_dot=args.export_dot,

@@ -41,6 +41,7 @@ bitwise the same weights as its sequential twin.
 
 from __future__ import annotations
 
+import itertools
 import time
 from typing import Any, Dict, List, Optional, Sequence
 
@@ -50,6 +51,7 @@ import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+from flexflow_tpu import telemetry as tel
 from flexflow_tpu.core.graph import topo_order
 from flexflow_tpu.losses import LossType, compute_loss
 from flexflow_tpu.metrics import compute_metrics
@@ -57,6 +59,12 @@ from flexflow_tpu.parallel.machine import MachineSpec
 from flexflow_tpu.parallel.sharding import Strategy, dims_to_pspec
 from flexflow_tpu.runtime.dataloader import SingleDataLoader, group_microbatches
 from flexflow_tpu.search import cost_model as cm
+
+
+# process-wide fit sequence: telemetry pipe events carry fit=<id> so the
+# bubble grouping (telemetry.pipeline_bubble_from_events) never merges two
+# fits whose update counters both restarted at 0 (init() resets iteration)
+_FIT_SEQ = itertools.count()
 
 
 def stage_device_groups(num_stages: int, per_stage: int) -> List[List]:
@@ -157,6 +165,12 @@ class PipelinedModel:
         self.cuts = sorted(int(c) for c in strategy.pipeline["cuts"])
         self._iteration = 0
         self.step_stats: Dict[str, int] = {}
+        # drift-monitor windows [(updates, wall_seconds)] per epoch of the
+        # last fit, and the telemetry-measured bubble accumulator (mean of
+        # per-update bubbles from the executed op timeline)
+        self._drift_windows: List[tuple] = []
+        self._bubble_sum = 0.0
+        self._bubble_n = 0
         if jax.process_count() != 1:
             raise NotImplementedError(
                 "pipeline parallelism is single-process for now (stage "
@@ -490,6 +504,16 @@ class PipelinedModel:
         loss_sum = None
         msum = None
         rngs = [jax.random.fold_in(rng_iter, m) for m in range(num_micro)]
+        # telemetry mode: each stage op is timed to COMPLETION
+        # (block_until_ready after dispatch) and emitted as a pipe/F|B
+        # event, so the measured bubble fraction comes from the real
+        # executed timeline. The blocking serializes the host against each
+        # op — it perturbs overlap, which is why it only happens with
+        # telemetry on; the default path dispatches fully asynchronously.
+        rec = tel.enabled()
+        ops: List[tuple] = []
+        upd = self._iteration
+        fid = getattr(self, "_fit_id", 0)
         for row in ticks:
             for (s, ph, m) in row:
                 if ph == "F":
@@ -504,12 +528,21 @@ class PipelinedModel:
                     stash_x[s][m] = x
                     stash_st[s][m] = state[s]
                     if s < S - 1:
+                        t0 = tel.now_us() if rec else 0.0
                         y, state[s] = self._f_fns[s](self.stage_params[s],
                                                      state[s], x, rngs[m])
+                        if rec:
+                            jax.block_until_ready(y)
+                            t1 = tel.now_us()
+                            ops.append((s, t0, t1))
+                            tel.record("pipe/F", t0, t1, cat="pipeline",
+                                       stage=s, micro=m, update=upd,
+                                       fit=fid)
                         ybuf[(s, m)] = y
                     # last stage: forward is fused into the backward slot
                     # (value_and_grad recomputes it) — F only stashes
                 else:
+                    t0 = tel.now_us() if rec else 0.0
                     if s == S - 1:
                         # the last stage's backward IS its forward
                         # (value_and_grad) — run it from the LIVE state so
@@ -543,6 +576,12 @@ class PipelinedModel:
                                                   PartitionSpec()))
                             loss_sum = rv if loss_sum is None \
                                 else loss_sum + rv
+                    if rec:
+                        jax.block_until_ready(gp)
+                        t1 = tel.now_us()
+                        ops.append((s, t0, t1))
+                        tel.record("pipe/B", t0, t1, cat="pipeline",
+                                   stage=s, micro=m, update=upd, fit=fid)
                     del stash_x[s][m], stash_st[s][m]
                     if s > 0:
                         # activation-gradient hop back to the upstream group
@@ -552,9 +591,22 @@ class PipelinedModel:
                         else self._acc_fns[s](acc[s], gp)
         inv = 1.0 / num_micro
         for s in range(S):
+            t0 = tel.now_us() if rec else 0.0
             self.stage_params[s], self.stage_opt[s] = self._upd_fns[s](
                 self.stage_params[s], self.stage_opt[s], acc[s],
                 jnp.float32(inv))
+            if rec:
+                jax.block_until_ready(self.stage_opt[s])
+                tel.record("pipe/update", t0, cat="pipeline-update",
+                           stage=s, update=upd)
+        if rec and ops:
+            # executed-timeline bubble of THIS update — the same
+            # accounting trace_report recomputes from the pipe/F|B events
+            # (telemetry.bubble_from_ops is the one shared definition)
+            b = tel.bubble_from_ops(S, ops)
+            if b is not None:
+                self._bubble_sum += b
+                self._bubble_n += 1
         self.stage_state = state
         mvals = jax.tree_util.tree_map(lambda v: v * inv, msum) \
             if msum is not None else {}
@@ -599,6 +651,9 @@ class PipelinedModel:
             "steps_per_dispatch": int(steps_per_dispatch
                                       or self.cfg.steps_per_dispatch)}
         ahead = max(1, int(self.cfg.dispatch_ahead))
+        self._drift_windows = []
+        self._bubble_sum, self._bubble_n = 0.0, 0
+        self._fit_id = next(_FIT_SEQ)
         history = []
         for epoch in range(epochs):
             # per-update losses fold into ONE device scalar (bounded
@@ -628,6 +683,15 @@ class PipelinedModel:
                     jax.block_until_ready(loss)
                     stats["barriers"] = stats.get("barriers", 0) + 1
             dt = time.perf_counter() - t0
+            self._drift_windows.append((nb, dt))
+            if self._bubble_n:
+                # mean of per-update executed-timeline bubbles so far
+                # (telemetry mode only — the async path has no honest
+                # per-op completion times to derive one from)
+                stats["measured_bubble"] = self._bubble_sum / self._bubble_n
+            if tel.enabled():
+                tel.record("fit/epoch", tel.now_us() - dt * 1e6, cat="fit",
+                           epoch=epoch, steps=nb)
             summ = pm.summary()
             summ["loss"] = float(np.asarray(loss_sum)) / nb if nb else 0.0
             summ["epoch_time_s"] = dt
@@ -642,7 +706,19 @@ class PipelinedModel:
             for cb in callbacks or []:
                 if hasattr(cb, "on_epoch_end"):
                     cb.on_epoch_end(epoch, summ)
+        self._fit_end_report(verbose)
         return history
+
+    def _fit_end_report(self, verbose: bool) -> None:
+        """Fit-end hooks, pipeline edition: drift event (predicted vs
+        measured UPDATE time, plus the measured bubble when telemetry
+        timed the ops), drift warning, failed-async-checkpoint warning."""
+        from flexflow_tpu.runtime.checkpoint import warn_failed_writes
+
+        tel.emit_fit_end(
+            self.drift_stats(), verbose,
+            measured_bubble=self.step_stats.get("measured_bubble"))
+        warn_failed_writes(verbose)
 
     def evaluate(self, x, y, batch_size: Optional[int] = None):
         from flexflow_tpu.metrics import PerfMetrics
@@ -779,6 +855,83 @@ class PipelinedModel:
             "bubble_closed_form": cm.pipeline_bubble_fraction(
                 self.schedule, self.num_stages, M),
         }
+
+    # ------------------------------------------------------------ profiling
+    def predicted_step_time(self) -> Optional[float]:
+        """The cost model's per-UPDATE prediction: the event-replay
+        makespan of this compile's schedule over M microbatches (the same
+        number the cut search ranked by) — comparable to drift_stats'
+        measured per-update windows."""
+        try:
+            t = float(self.predicted_schedule()["makespan_s"])
+            return t if t > 0 else None
+        except Exception:
+            return None
+
+    def drift_stats(self) -> dict:
+        return tel.drift_stats(self.predicted_step_time(),
+                               list(self._drift_windows))
+
+    def profile_report(self, top: int = 0, print_table: bool = True):
+        """Per-op timing table, pipeline edition: each stage's layers under
+        the dp candidate on the STAGE machine (analytic + isolated
+        measured), plus [pipeline] (schedule + predicted vs measured
+        bubble), [drift], [memory] per stage, and any failed async
+        checkpoint writes. Returns the rows (each tagged with its stage)."""
+        from flexflow_tpu.search.candidates import layer_candidates
+        from flexflow_tpu.search.measure import MeasuredCost
+
+        mc = MeasuredCost(self.stage_machine, repeats=3, warmup=1,
+                          cache_dir="")
+        bs = self._batch_sizes()
+        rows = []
+        for s, seg in enumerate(self.stage_layers):
+            for layer in seg:
+                cand = layer_candidates(layer, self.stage_machine, bs)[0]
+                if cand.passthrough:
+                    continue
+                rows.append({
+                    "stage": s,
+                    "layer": layer.name,
+                    "op": layer.op_type.value,
+                    "candidate": cand.name,
+                    "analytic_us": cand.op_time(layer,
+                                                self.stage_machine) * 1e6,
+                    "measured_us": mc.op_time(layer, cand) * 1e6,
+                })
+        rows.sort(key=lambda x: (x["stage"], -x["measured_us"]))
+        if top:
+            rows = rows[:top]
+        if print_table:
+            print(f"{'st':>2} {'layer':26} {'op':16} {'analytic':>10} "
+                  f"{'measured':>10}")
+            for x in rows:
+                print(f"{x['stage']:2d} {x['layer'][:26]:26} "
+                      f"{x['op'][:16]:16} {x['analytic_us']:9.1f}u "
+                      f"{x['measured_us']:9.1f}u")
+            pred = self.predicted_schedule()
+            mb = self.step_stats.get("measured_bubble")
+            print(f"[pipeline] stages={self.num_stages} "
+                  f"schedule={self.schedule} cuts={list(self.cuts)} "
+                  f"predicted_bubble={pred['bubble']:.3f} "
+                  + (f"measured_bubble={mb:.3f}" if mb is not None
+                     else "measured_bubble=n/a (enable --telemetry-dir)"))
+            for line in tel.format_drift(self.drift_stats()):
+                print(line)
+            mem = self.memory_stats()
+            mbyte = 1024 * 1024
+            for s in range(self.num_stages):
+                print(f"[memory] stage {s}: params "
+                      f"{mem['per_stage_param_bytes'][s] / mbyte:.2f}MB, "
+                      f"opt state "
+                      f"{mem['per_stage_opt_bytes'][s] / mbyte:.2f}MB "
+                      "per device")
+            from flexflow_tpu.runtime.checkpoint import \
+                report_failed_writes
+
+            for line in report_failed_writes():
+                print(line)
+        return rows
 
     # ----------------------------------------------------------- checkpoint
     def save_checkpoint(self, path: str, block: Optional[bool] = None) -> str:

@@ -32,6 +32,8 @@ from typing import Any, Dict, List, Optional
 import jax
 import numpy as np
 
+from flexflow_tpu import telemetry as tel
+
 
 def _ckpt_dir(path: str) -> str:
     return os.path.abspath(path)
@@ -40,6 +42,40 @@ def _ckpt_dir(path: str) -> str:
 # ------------------------------------------------------- async write registry
 _PENDING: Dict[str, "_AsyncSave"] = {}
 _PENDING_LOCK = threading.Lock()
+# failed async writes not yet re-raised to a caller: [{"path", "error",
+# "handle"}]. result()/wait_pending clears an entry when it REPORTS the
+# error; until then failed_writes() keeps it visible (fit-end summary,
+# profile_report) so a dropped checkpoint can't go unnoticed.
+_FAILED: List[Dict[str, Any]] = []
+
+
+def failed_writes() -> List[Dict[str, str]]:
+    """FAILED async checkpoint writes whose error has not yet been
+    re-raised (wait_pending()/result() consume an entry when they report
+    it). Surfaced by CompiledModel's fit-end summary and profile_report."""
+    with _PENDING_LOCK:
+        return [{"path": d["path"], "error": d["error"]} for d in _FAILED]
+
+
+def warn_failed_writes(verbose: bool) -> None:
+    """The fit-end summary warning, shared by CompiledModel and
+    PipelinedModel: log (and, verbose, print) any still-unreported failed
+    async writes so a dropped checkpoint can't go unnoticed."""
+    fw = failed_writes()
+    if not fw:
+        return
+    msg = (f"{len(fw)} async checkpoint write(s) FAILED: "
+           + "; ".join(f"{f['path']}: {f['error']}" for f in fw)
+           + " — call wait_checkpoints() to re-raise")
+    logging.getLogger("flexflow_tpu").warning(msg)
+    if verbose:
+        print(f"[checkpoint] WARNING: {msg}")
+
+
+def report_failed_writes() -> List[str]:
+    """The profile_report lines for still-unreported failed writes."""
+    return [f"[checkpoint] FAILED async write: {f['path']}: {f['error']}"
+            for f in failed_writes()]
 
 
 _EXIT_HOOKED = False
@@ -84,7 +120,9 @@ class _AsyncSave:
 
     def _run(self, write_fn):
         try:
-            write_fn()
+            with tel.span("checkpoint/write", cat="checkpoint",
+                          path=self.path):
+                write_fn()
             # success: deregister here. A FAILED handle stays in _PENDING
             # until result() reports the error — otherwise a fast-failing
             # write would vanish before wait_pending/restore could see it
@@ -94,6 +132,14 @@ class _AsyncSave:
                     del _PENDING[self.path]
         except BaseException as e:  # surfaced via result()/wait_pending()
             self._exc = e
+            # report the failure THE MOMENT it happens, not only when
+            # someone eventually joins: telemetry error event + the
+            # failed_writes() registry the fit-end summary reads
+            with _PENDING_LOCK:
+                _FAILED.append({"path": self.path, "error": repr(e),
+                                "handle": self})
+            tel.error("checkpoint/write_failed", path=self.path,
+                      error=repr(e))
             logging.getLogger("flexflow_tpu").error(
                 "async checkpoint write to %s failed: %s", self.path, e)
 
@@ -115,6 +161,9 @@ class _AsyncSave:
             if _PENDING.get(self.path) is self:
                 del _PENDING[self.path]
         if self._exc is not None:
+            with _PENDING_LOCK:  # error reported here: clear the registry
+                _FAILED[:] = [d for d in _FAILED
+                              if d.get("handle") is not self]
             raise self._exc
         return self.path
 
@@ -128,8 +177,12 @@ def wait_pending(path: Optional[str] = None) -> None:
         else:
             h = _PENDING.get(_ckpt_dir(path))
             handles = [h] if h is not None else []
-    for h in handles:
-        h.result()
+    if not handles:
+        return
+    with tel.span("checkpoint/drain", cat="checkpoint",
+                  pending=len(handles)):
+        for h in handles:
+            h.result()
 
 
 # ------------------------------------------------------------------ save/load
@@ -177,11 +230,14 @@ def save_checkpoint(cm, path: str, block: bool = True) -> str:
     tree = {"params": cm.params, "opt_state": cm.opt_state}
     ckptr = ocp.StandardCheckpointer()  # caller thread: see _write_tree
     if block or jax.process_count() > 1:
-        _write_tree(ckptr, path, tree, meta, state)
+        with tel.span("checkpoint/write", cat="checkpoint", path=path,
+                      blocking=True):
+            _write_tree(ckptr, path, tree, meta, state)
         return path
     # copy-then-write: D2H snapshot here (donation-safe — the live buffers
     # may be consumed by the next train_step), serialization off-thread
-    host_tree = jax.tree_util.tree_map(np.asarray, tree)
+    with tel.span("checkpoint/snapshot", cat="checkpoint", path=path):
+        host_tree = jax.tree_util.tree_map(np.asarray, tree)
     _register_exit_drain()
     handle = _AsyncSave(path)
     with _PENDING_LOCK:
@@ -217,9 +273,12 @@ def save_pipeline_checkpoint(pm, path: str, block: bool = True) -> str:
     state = {k: np.asarray(v) for d in pm.stage_state for k, v in d.items()}
     ckptr = ocp.StandardCheckpointer()
     if block or jax.process_count() > 1:
-        _write_tree(ckptr, path, tree, meta, state)
+        with tel.span("checkpoint/write", cat="checkpoint", path=path,
+                      blocking=True):
+            _write_tree(ckptr, path, tree, meta, state)
         return path
-    host_tree = jax.tree_util.tree_map(np.asarray, tree)
+    with tel.span("checkpoint/snapshot", cat="checkpoint", path=path):
+        host_tree = jax.tree_util.tree_map(np.asarray, tree)
     _register_exit_drain()
     handle = _AsyncSave(path)
     with _PENDING_LOCK:

@@ -19,6 +19,8 @@ from typing import Iterator, List, Optional, Sequence, Tuple
 import jax
 import numpy as np
 
+from flexflow_tpu import telemetry as tel
+
 
 class SingleDataLoader:
     def __init__(self, xs: Sequence[np.ndarray], y: np.ndarray, batch_size: int,
@@ -125,33 +127,46 @@ def prefetch_multi(it, k, input_shardings, label_sharding,
     _DONE = object()
     if put is None:
         put = jax.device_put
+    # telemetry: per-transfer spans + queue-occupancy counter samples from
+    # the worker thread (captured once — zero added work when disabled)
+    rec = tel.enabled()
 
     def _xfer(xs, y, in_sh, lab_sh):
+        t0 = tel.now_us() if rec else 0.0
         dx = [put(x, s) if s is not None else jax.device_put(x)
               for x, s in zip(xs, in_sh)]
         dy = put(y, lab_sh) if lab_sh is not None else jax.device_put(y)
+        if rec:
+            tel.record("dataloader/transfer", t0, cat="dataloader")
         return dx, dy
+
+    def _enqueue(item):
+        q.put(item)
+        if rec:
+            tel.counter("dataloader/queue_depth", q.qsize(),
+                        cat="dataloader")
 
     def worker():
         try:
             buf: List = []
             for xs, y in it:
                 if k <= 1:
-                    q.put(("1",) + _xfer(xs, y, input_shardings, label_sharding))
+                    _enqueue(("1",) + _xfer(xs, y, input_shardings,
+                                            label_sharding))
                     continue
                 if buf and _batch_shapes(xs, y) != _batch_shapes(*buf[0]):
                     # ragged batch (e.g. short remainder): flush the
                     # partial group singly — stacking would crash
                     for bxs, by in buf:
-                        q.put(("1",) + _xfer(bxs, by, input_shardings,
-                                             label_sharding))
+                        _enqueue(("1",) + _xfer(bxs, by, input_shardings,
+                                                label_sharding))
                     buf = []
                 buf.append((xs, y))
                 if len(buf) == k:
                     sx = [np.stack([b[0][i] for b in buf])
                           for i in range(len(buf[0][0]))]
                     sy = np.stack([b[1] for b in buf])
-                    q.put(("k",) + _xfer(
+                    _enqueue(("k",) + _xfer(
                         sx, sy,
                         stacked_input_shardings or input_shardings,
                         stacked_label_sharding
@@ -159,7 +174,8 @@ def prefetch_multi(it, k, input_shardings, label_sharding,
                         else label_sharding))
                     buf = []
             for xs, y in buf:  # tail: fewer than k batches left
-                q.put(("1",) + _xfer(xs, y, input_shardings, label_sharding))
+                _enqueue(("1",) + _xfer(xs, y, input_shardings,
+                                        label_sharding))
             q.put(_DONE)
         except BaseException as e:  # forward to the consumer, don't swallow
             q.put(e)

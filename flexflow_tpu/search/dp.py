@@ -155,7 +155,27 @@ def _live_coords(li, n_layers, coords, last_use):
     return frozenset(out)
 
 
-def search_graph(model, machine: MachineSpec, beam_width: int = 64,
+def search_graph(model, machine, *args, **kwargs):
+    """Telemetry shim over the frontier DP (_search_graph_impl keeps the
+    real signature): one "search/dp" span per DP run, carrying the
+    expansion count this run added to SEARCH_STATS — the per-candidate-
+    graph cost the unity loop pays is visible in the trace stream."""
+    from flexflow_tpu import telemetry as tel
+
+    if not tel.enabled():
+        return _search_graph_impl(model, machine, *args, **kwargs)
+    t0 = tel.now_us()
+    e0 = SEARCH_STATS.get("expansions", 0)
+    r = _search_graph_impl(model, machine, *args, **kwargs)
+    tel.record("search/dp", t0, cat="compile",
+               layers=len(model.layers),
+               expansions=SEARCH_STATS.get("expansions", 0) - e0,
+               cost_s=(r.cost if not isinstance(r, list)
+                       else (r[0].cost if r else None)))
+    return r
+
+
+def _search_graph_impl(model, machine: MachineSpec, beam_width: int = 64,
                  enable_parameter: bool = True, enable_attribute: bool = True,
                  mem_budget: Optional[float] = None,
                  cost_fn=None,

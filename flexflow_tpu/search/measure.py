@@ -42,10 +42,15 @@ def _shard_shape(spec, dims, machine):
 
 class MeasuredCost:
     def __init__(self, machine: MachineSpec, repeats: int = 5, warmup: int = 2,
-                 cache_dir: Optional[str] = None):
+                 windows: int = 3, cache_dir: Optional[str] = None):
         self.machine = machine
         self.repeats = repeats
         self.warmup = warmup
+        # median-of-windows: each measurement is `windows` independent
+        # timed windows of `repeats` runs, reduced by MEDIAN — one window
+        # stolen by a concurrent process (the tier-1 test_measure flake)
+        # can no longer zero out a bwd = total - fwd difference
+        self.windows = max(1, windows)
         self.cache: Dict[Tuple, Tuple[float, float]] = {}
         self._floor: float = -1.0  # lazy: scalar-fetch RTT (tunnel latency)
         # persistent (params_key, layout, machine) -> (fwd, bwd) store (the
@@ -191,16 +196,26 @@ class MeasuredCost:
         np.asarray(jax.device_get(scalar))
 
     def _time(self, fn, *args) -> float:
+        """Median over `windows` timed windows of `repeats` dispatches
+        each (floor-corrected per window). The shared timing protocol:
+        every consumer — the measured search, tools/calibrate.py,
+        profile_report — gets the same robustness to a scheduler hiccup
+        landing inside one window, instead of a single wall-clock delta
+        the hiccup corrupts outright."""
         out = fn(*args)
         self._host_sync(out)
         for _ in range(self.warmup):
             self._host_sync(fn(*args))
         floor = self._fetch_floor()
-        t0 = time.perf_counter()
-        for _ in range(self.repeats):
-            out = fn(*args)
-        self._host_sync(out)
-        return max(0.0, time.perf_counter() - t0 - floor) / self.repeats
+        ts = []
+        for _ in range(self.windows):
+            t0 = time.perf_counter()
+            for _ in range(self.repeats):
+                out = fn(*args)
+            self._host_sync(out)
+            ts.append(max(0.0, time.perf_counter() - t0 - floor)
+                      / self.repeats)
+        return float(np.median(ts))
 
     def _measure(self, layer: "Layer", cand: "Candidate") -> Tuple[float, float]:
         machine = self.machine

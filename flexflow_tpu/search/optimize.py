@@ -81,11 +81,21 @@ def graph_optimize(model, machine: MachineSpec,
         cached = sc.lookup(cache_dir, key, model, machine)
         if cached is not None:
             return cached
+    from flexflow_tpu import telemetry as tel
     from flexflow_tpu.search.unity import unity_optimize
 
     t0 = time.perf_counter()
-    st, stats = unity_optimize(model, machine, cost_fn=cost_fn,
-                               opt_mem=opt_mem)
+    with tel.span("search/unity", cat="compile",
+                  measured=bool(cost_fn is not None)):
+        st, stats = unity_optimize(model, machine, cost_fn=cost_fn,
+                                   opt_mem=opt_mem)
+    # stamp the search's own per-step prediction: the drift monitor
+    # compares THIS number (what the search believed when it chose the
+    # strategy) against what fit actually measures
+    st._predicted_cost = stats.best_cost
+    tel.event("search/result", cat="compile", cost_s=stats.best_cost,
+              baseline_cost_s=stats.baseline_cost,
+              expansions=stats.expansions)
     if use_cache:
         if cost_fn is not None:
             # the measured search wrote new microbenchmarks into the store

@@ -20,6 +20,7 @@ import dataclasses
 import heapq
 from typing import Dict, List, Optional, Tuple
 
+from flexflow_tpu import telemetry as tel
 from flexflow_tpu.core.graph import topo_order
 from flexflow_tpu.ops.op_type import PARALLEL_OPS, OperatorType
 from flexflow_tpu.parallel.machine import MachineSpec
@@ -102,6 +103,7 @@ def substitution_optimize(pcg: PCG, machine: MachineSpec,
             stats.pruned += 1
             continue
         stats.expansions += 1
+        t_exp = tel.now_us() if tel.enabled() else None
         order = topo_order(g.layers)
         pos = {id(l): i for i, l in enumerate(order)}
         for xi, xfer in enumerate(xfers):
@@ -129,6 +131,9 @@ def substitution_optimize(pcg: PCG, machine: MachineSpec,
                 if nr.cost <= alpha * best_r.cost:
                     counter += 1
                     heapq.heappush(heap, (nr.cost, counter, ng, npath))
+        if t_exp is not None:
+            tel.record("search/substitution_round", t_exp, cat="compile",
+                       expansion=stats.expansions, frontier_cost_s=c)
     stats.best_cost = best_r.cost
     return best, best_r, stats
 
@@ -380,9 +385,12 @@ def unity_optimize(model, machine: MachineSpec, cost_fn=None,
                                  enable_attribute=en_attr, pins=g.pins,
                                  topk=cfg.simulator_topk,
                                  prefix_cache=dp_cache, opt_mem=opt_mem)
-        picked, _reports = sim.rerank(
-            g, machine, finalists, cost_fn=cost_fn,
-            segment_bytes=cfg.simulator_segment_size)
+        with tel.span("search/sim_rerank", cat="compile",
+                      finalists=len(finalists)
+                      if isinstance(finalists, list) else 1):
+            picked, _reports = sim.rerank(
+                g, machine, finalists, cost_fn=cost_fn,
+                segment_bytes=cfg.simulator_segment_size)
         sim_cache[sim_key] = picked
         return picked
 

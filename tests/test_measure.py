@@ -118,10 +118,12 @@ def test_fwd_bwd_timed_independently(devices):
     m.embedding(x, 5000, 64, name="emb")
     emb = m.get_layer_by_name("emb")
     (dp,) = [c for c in layer_candidates(emb, MACH, {64}) if c.name == "dp"]
-    # bwd is (grad-step time - fwd time): a real wall-clock difference that
-    # can collapse to <= 0 when a CONCURRENT pytest run steals the cores
-    # mid-measurement (known tier-1 flake). Re-measure with more repeats
-    # before asserting, and keep the positivity check soft: the property
+    # bwd is (grad-step time - fwd time). The shared timing protocol now
+    # reduces each measurement by MEDIAN over independent windows
+    # (MeasuredCost._time), so one window stolen by a CONCURRENT pytest
+    # run no longer collapses the difference to <= 0 — the historical
+    # tier-1 flake. The re-measure loop below stays as a backstop for
+    # sustained load; the positivity check remains soft: the property
     # under test is that bwd is an INDEPENDENT measurement, not its sign
     # under scheduler noise.
     mc = MeasuredCost(MACH, repeats=3, warmup=1)
@@ -129,7 +131,7 @@ def test_fwd_bwd_timed_independently(devices):
     for repeats in (7, 15):
         if bwd > 0:
             break
-        mc = MeasuredCost(MACH, repeats=repeats, warmup=2)
+        mc = MeasuredCost(MACH, repeats=repeats, warmup=2, windows=5)
         fwd, bwd = mc.op_times(emb, dp)
     assert fwd > 0 and np.isfinite(bwd)
     # bwd came from measurement, not the 2x-fwd approximation
