@@ -119,6 +119,12 @@ def compile_model(model, optimizer, loss_type: LossType, metrics: Sequence[Metri
     # telemetry.shutdown(), never a side effect of a later compile)
     if getattr(cfg, "telemetry_dir", ""):
         tel.configure(cfg.telemetry_dir)
+    # --fault-plan arms the deterministic fault injector (FF_FAULT_PLAN is
+    # read at faults import; an explicit config plan overrides it)
+    if getattr(cfg, "fault_plan", ""):
+        from flexflow_tpu.runtime import faults
+
+        faults.configure(cfg.fault_plan)
     with tel.span("compile/compile_model", cat="compile",
                   pipeline_stages=int(cfg.pipeline_stages)):
         return _compile_model(model, optimizer, loss_type, metrics, outputs)
@@ -714,7 +720,11 @@ class CompiledModel:
             callbacks=None, verbose: bool = True,
             sync_every: Optional[int] = None,
             steps_per_dispatch: Optional[int] = None,
-            accum_steps: Optional[int] = None):
+            accum_steps: Optional[int] = None,
+            resume: Optional[str] = None,
+            checkpoint_dir: Optional[str] = None,
+            checkpoint_every_steps: Optional[int] = None,
+            checkpoint_every_secs: Optional[float] = None):
         # per-call overrides of the async-pipeline knobs (see config.py);
         # None = the config's value, threaded through (cfg never mutated)
         if sync_every is None:
@@ -731,16 +741,33 @@ class CompiledModel:
             self._accum_steps = max(1, int(accum_steps))
             self._build_steps()
         return self._fit(x, y, batch_size, epochs, callbacks, verbose,
-                         sync_every, steps_per_dispatch)
+                         sync_every, steps_per_dispatch,
+                         resume, checkpoint_dir, checkpoint_every_steps,
+                         checkpoint_every_secs)
 
     def _fit(self, x, y, batch_size, epochs, callbacks, verbose,
-             sync_every, steps_per_dispatch):
+             sync_every, steps_per_dispatch, resume=None,
+             checkpoint_dir=None, checkpoint_every_steps=None,
+             checkpoint_every_secs=None):
+        from flexflow_tpu.runtime.resilience import FitResilience
+
         xs = x if isinstance(x, (list, tuple)) else [x]
         batch_size = batch_size or self.cfg.batch_size
         epochs = epochs or self.cfg.epochs
         if self.params is None:
             self.init()
         batch_size = self._coerce_batch(batch_size)
+        # resilience (runtime/resilience.py): durable periodic checkpoints,
+        # SIGTERM/SIGINT drain, resume="auto". None when fully off — the
+        # loop below then runs exactly the PR-2 async pipeline.
+        res = FitResilience.build(self, resume, checkpoint_dir,
+                                  checkpoint_every_steps,
+                                  checkpoint_every_secs)
+        if res is not None:
+            # effective (per-call) knobs, not cfg: they define what the
+            # manifest's progress counters mean, for save AND resume check
+            res.set_effective(batch_size, self._accum_steps)
+        progress = res.resume_now(verbose) if res is not None else None
         loader = SingleDataLoader(xs, y, batch_size, shuffle=True, seed=self.cfg.seed)
         in_sh = [self.input_sharding(t) for t in self.model.input_tensors]
         lab_sh = self.label_sharding((batch_size,) + tuple(np.asarray(y).shape[1:]))
@@ -761,7 +788,7 @@ class CompiledModel:
             history = self._fit_epochs(epochs, loader, in_sh, lab_sh,
                                        base_rng, batch_size, callbacks,
                                        verbose, sync_every,
-                                       steps_per_dispatch)
+                                       steps_per_dispatch, res, progress)
         finally:
             if prof_ctx is not None:
                 prof_ctx.__exit__(None, None, None)
@@ -787,7 +814,7 @@ class CompiledModel:
 
     def _fit_epochs(self, epochs, loader, in_sh, lab_sh, base_rng,
                     batch_size, callbacks, verbose, sync_every,
-                    steps_per_dispatch):
+                    steps_per_dispatch, res=None, progress=None):
         """Asynchronous training pipeline (the Legion async-launch analog):
         the host's only per-step work is folding the rng key and issuing
         the next dispatch — loss/metrics stay device-resident (deferred
@@ -804,8 +831,28 @@ class CompiledModel:
         fused_steps for the whole fit; each epoch's history entry carries
         its own dispatches/host_syncs (tools/bench_step.py --check asserts
         dispatches <= ceil(num_batches/K) and zero mid-epoch host syncs in
-        the default config)."""
-        history = []
+        the default config).
+
+        `res` (runtime/resilience.FitResilience, None = off) adds durable
+        periodic checkpoints + SIGTERM/SIGINT drain, and `progress` (from
+        a restored snapshot's manifest) resumes MID-RUN on the identical
+        trajectory: the loader's shuffle rng fast-forwards past the
+        completed epochs, the interrupted epoch skips its already-consumed
+        accumulation groups, and the epoch's loss/metric accumulators are
+        re-seeded from the snapshot so its summary covers the full epoch."""
+        from flexflow_tpu.runtime import faults as _faults
+        from flexflow_tpu.runtime.resilience import (RetryPolicy,
+                                                     progress_dict,
+                                                     run_resilient,
+                                                     start_state)
+
+        policy = res.policy if res is not None \
+            else RetryPolicy.from_config(self.cfg)
+        start_epoch, skip_steps, history = start_state(progress)
+        if progress:
+            # the dataloader cursor: epochs 0..start_epoch-1 consumed their
+            # shuffles; the resumed epoch below re-draws the SAME one
+            loader.advance_epochs(start_epoch)
         per_batch_cbs = [cb for cb in callbacks or []
                          if hasattr(cb, "on_batch_end")]
         ahead = max(1, int(self.cfg.dispatch_ahead))
@@ -836,126 +883,188 @@ class CompiledModel:
         rec = tel.enabled()
         prof = jax.profiler.StepTraceAnnotation if self.cfg.profiling \
             else None
-        for epoch in range(epochs):
-            # fallbacks re-evaluated per epoch: a recompile trigger
-            # registered mid-fit (e.g. by on_epoch_end) must drop the loop
-            # to 1-step dispatch — and _get_multi must be re-fetched after
-            # any recompile rebuilt the step functions
-            k = max(1, int(steps_per_dispatch))
-            sync = max(0, int(sync_every))
-            if per_batch_cbs or self.recompile_state is not None:
-                k, sync = 1, 1  # per-step host control required
-            multi = self._get_multi(k) if k > 1 else None
-            pm = PerfMetrics()
-            t0 = time.perf_counter()
-            # loss rides a second deferred PerfMetrics keyed by STEPS (not
-            # samples): device chunk-folding bounds memory on long epochs.
-            # Parity with the old `loss_sum += float(loss)` loop is
-            # bit-exact below fold_after pending steps, ~1e-7 relative
-            # beyond (see PerfMetrics docstring)
-            pml = PerfMetrics()
-            nb = 0
-            ep_disp = ep_sync = 0
-            since_sync = 0
-            gen = prefetch_multi(
-                group_microbatches(loader.epoch(), accum), k,
-                in_sh_u, lab_sh_u, in_sh_k, lab_sh_k,
-                put=self._put)
-            while True:
-                # telemetry: the gap between "want next batch" and
-                # "prefetcher delivered" is the data-wait cost the async
-                # loop is supposed to hide
-                if rec:
-                    t_w = tel.now_us()
-                    item = next(gen, None)
-                    tel.record("fit/prefetch_wait", t_w, cat="fit")
-                else:
-                    item = next(gen, None)
-                if item is None:
-                    break
-                kind, dx, dy = item
-                if rec:
-                    t_d = tel.now_us()
-                ann = prof("train", step_num=self._iteration) \
-                    if prof is not None else tel.NULL_SPAN
-                with ann:
-                    if kind == "k":
-                        (self.params, self.opt_state, self.state, loss,
-                         mvals) = multi(self.params, self.opt_state,
-                                        self.state, dx, dy, base_rng,
-                                        jnp.int32(self._iteration))
-                        steps = k
-                        stats["fused_steps"] += k
-                    else:  # single step (k==1, or the fused-epoch tail)
-                        rng = jax.random.fold_in(base_rng, self._iteration)
-                        (self.params, self.opt_state, self.state, loss,
-                         mvals) = self.train_step(self.params,
-                                                  self.opt_state,
-                                                  self.state, dx, dy, rng)
-                        steps = 1
-                self._iteration += steps
-                nb += steps
-                since_sync += steps
-                ep_disp += 1
-                stats["dispatches"] += 1
-                if rec:
-                    tel.record("fit/dispatch", t_d, cat="fit", kind=kind,
-                               steps=steps, iteration=self._iteration)
-                pml.update_deferred(steps, {"loss": loss})
-                pm.update_deferred(batch_size * accum * steps, mvals)
-                if sync and since_sync >= sync:
-                    if rec:
-                        t_s = tel.now_us()
-                    pml.materialize()
-                    pm.materialize()
-                    if rec:
-                        tel.record("fit/host_sync", t_s, cat="fit",
-                                   iteration=self._iteration)
-                    stats["host_syncs"] += 1
-                    ep_sync += 1
-                    since_sync = 0
-                elif ep_disp % ahead == 0:
-                    # bounded dispatch-ahead: wait for the device to catch
-                    # up (no host transfer, just a queue-depth barrier)
-                    if rec:
-                        t_b = tel.now_us()
-                    jax.block_until_ready(loss)
-                    if rec:
-                        tel.record("fit/barrier_sync", t_b, cat="fit",
-                                   iteration=self._iteration)
-                    stats["barriers"] += 1
-                for cb in per_batch_cbs:
-                    cb.on_batch_end(self._iteration, {"loss": float(loss)})
-                if kind == "1":
-                    self._maybe_recompile()
-            # epoch end: the one unavoidable materialization (not counted
-            # as a mid-epoch host sync)
-            if rec:
-                t_s = tel.now_us()
-            pml.materialize()
-            if rec:
-                tel.record("fit/host_sync", t_s, cat="fit",
-                           scope="epoch_end")
-            dt = time.perf_counter() - t0
-            self._drift_windows.append((nb, dt))
-            if rec:
-                tel.record("fit/epoch", tel.now_us() - dt * 1e6,
-                           cat="fit", epoch=epoch, steps=nb)
-            summ = pm.summary()
-            summ["loss"] = pml.sums.get("loss", 0.0) / max(1, nb)
-            summ["epoch_time_s"] = dt
-            summ["samples_per_sec"] = pm.train_all / dt if dt > 0 else 0.0
-            summ["dispatches"] = float(ep_disp)
-            summ["host_syncs"] = float(ep_sync)
-            history.append(summ)
-            if verbose:
-                ms = " ".join(f"{k_}={v:.4f}" for k_, v in summ.items()
-                              if k_ not in ("samples", "dispatches",
-                                            "host_syncs"))
-                print(f"[epoch {epoch}] {ms}")
-            for cb in callbacks or []:
-                if hasattr(cb, "on_epoch_end"):
-                    cb.on_epoch_end(epoch, summ)
+        faults_on = _faults.active()
+        if res is not None:
+            res.install_guard()
+        try:
+            for epoch in range(start_epoch, epochs):
+              # fallbacks re-evaluated per epoch: a recompile trigger
+              # registered mid-fit (e.g. by on_epoch_end) must drop the loop
+              # to 1-step dispatch — and _get_multi must be re-fetched after
+              # any recompile rebuilt the step functions
+              k = max(1, int(steps_per_dispatch))
+              sync = max(0, int(sync_every))
+              if per_batch_cbs or self.recompile_state is not None:
+                  k, sync = 1, 1  # per-step host control required
+              multi = self._get_multi(k) if k > 1 else None
+              pm = PerfMetrics()
+              t0 = time.perf_counter()
+              # loss rides a second deferred PerfMetrics keyed by STEPS (not
+              # samples): device chunk-folding bounds memory on long epochs.
+              # Parity with the old `loss_sum += float(loss)` loop is
+              # bit-exact below fold_after pending steps, ~1e-7 relative
+              # beyond (see PerfMetrics docstring)
+              pml = PerfMetrics()
+              nb = 0
+              # steps/samples re-seeded from a resumed snapshot: the epoch
+              # SUMMARY covers the whole epoch, but wall-clock-derived
+              # stats (drift windows, samples/sec) must only count work
+              # executed in THIS session
+              seed_steps = seed_samples = 0
+              # resume mid-epoch: the first `skip_steps` accumulation
+              # groups were consumed before the snapshot — the loader
+              # fast-forwards past their batches WITHOUT gathering them
+              # (snapshots land on dispatch boundaries, so the skipped
+              # region is whole accum-groups), and the epoch accumulators
+              # re-seed from the manifest so this epoch's summary still
+              # covers the WHOLE epoch
+              resuming = epoch == start_epoch and progress
+              grouped = group_microbatches(
+                  loader.epoch(skip_batches=skip_steps * accum
+                               if resuming else 0), accum)
+              if resuming:
+                  nb = seed_steps = skip_steps
+                  pml.sums["loss"] = float(progress.get("loss_sum", 0.0))
+                  pml.train_all = nb
+                  pm.sums = {mk: float(mv) for mk, mv in
+                             (progress.get("metric_sums") or {}).items()}
+                  pm.train_all = seed_samples = int(progress.get("samples", 0))
+              ep_disp = ep_sync = 0
+              since_sync = 0
+              gen = prefetch_multi(
+                  grouped, k,
+                  in_sh_u, lab_sh_u, in_sh_k, lab_sh_k,
+                  put=self._put, retry_policy=policy)
+
+              def make_progress(_pml=pml, _pm=pm, _epoch=epoch):
+                  # durable progress counters for res.maybe_checkpoint
+                  # (reads nb/history at call time)
+                  _pml.materialize()
+                  _pm.materialize()
+                  return progress_dict(_epoch, nb,
+                                       _pml.sums.get("loss", 0.0),
+                                       _pm.sums, _pm.train_all, history)
+
+              while True:
+                  # telemetry: the gap between "want next batch" and
+                  # "prefetcher delivered" is the data-wait cost the async
+                  # loop is supposed to hide
+                  if rec:
+                      t_w = tel.now_us()
+                      item = next(gen, None)
+                      tel.record("fit/prefetch_wait", t_w, cat="fit")
+                  else:
+                      item = next(gen, None)
+                  if item is None:
+                      break
+                  kind, dx, dy = item
+                  if faults_on:
+                      # the fit/dispatch fault site: admission check BEFORE
+                      # the jitted call (nothing consumed yet, retry-safe
+                      # even under donation). One check per 1-based global
+                      # step COVERED by this dispatch — "fail step 3" is
+                      # fit/dispatch@3 regardless of how steps batch into
+                      # fused dispatches (the faults.py contract)
+                      for s in range(self._iteration + 1,
+                                     self._iteration + 1
+                                     + (k if kind == "k" else 1)):
+                          run_resilient("fit/dispatch", lambda: None,
+                                        policy, index=s)
+                  if rec:
+                      t_d = tel.now_us()
+                  ann = prof("train", step_num=self._iteration) \
+                      if prof is not None else tel.NULL_SPAN
+                  with ann:
+                      if kind == "k":
+                          (self.params, self.opt_state, self.state, loss,
+                           mvals) = multi(self.params, self.opt_state,
+                                          self.state, dx, dy, base_rng,
+                                          jnp.int32(self._iteration))
+                          steps = k
+                          stats["fused_steps"] += k
+                      else:  # single step (k==1, or the fused-epoch tail)
+                          rng = jax.random.fold_in(base_rng, self._iteration)
+                          (self.params, self.opt_state, self.state, loss,
+                           mvals) = self.train_step(self.params,
+                                                    self.opt_state,
+                                                    self.state, dx, dy, rng)
+                          steps = 1
+                  self._iteration += steps
+                  nb += steps
+                  since_sync += steps
+                  ep_disp += 1
+                  stats["dispatches"] += 1
+                  if rec:
+                      tel.record("fit/dispatch", t_d, cat="fit", kind=kind,
+                                 steps=steps, iteration=self._iteration)
+                  pml.update_deferred(steps, {"loss": loss})
+                  pm.update_deferred(batch_size * accum * steps, mvals)
+                  if sync and since_sync >= sync:
+                      if rec:
+                          t_s = tel.now_us()
+                      pml.materialize()
+                      pm.materialize()
+                      if rec:
+                          tel.record("fit/host_sync", t_s, cat="fit",
+                                     iteration=self._iteration)
+                      stats["host_syncs"] += 1
+                      ep_sync += 1
+                      since_sync = 0
+                  elif ep_disp % ahead == 0:
+                      # bounded dispatch-ahead: wait for the device to catch
+                      # up (no host transfer, just a queue-depth barrier)
+                      if rec:
+                          t_b = tel.now_us()
+                      jax.block_until_ready(loss)
+                      if rec:
+                          tel.record("fit/barrier_sync", t_b, cat="fit",
+                                     iteration=self._iteration)
+                      stats["barriers"] += 1
+                  if res is not None:
+                      res.maybe_checkpoint(loss, make_progress)
+                  for cb in per_batch_cbs:
+                      cb.on_batch_end(self._iteration, {"loss": float(loss)})
+                  if kind == "1":
+                      self._maybe_recompile()
+              # epoch end: the one unavoidable materialization (not counted
+              # as a mid-epoch host sync)
+              if rec:
+                  t_s = tel.now_us()
+              pml.materialize()
+              if rec:
+                  tel.record("fit/host_sync", t_s, cat="fit",
+                             scope="epoch_end")
+              dt = time.perf_counter() - t0
+              # drift/throughput count only work executed THIS session: a
+              # resumed epoch's re-seeded steps/samples ran before the
+              # snapshot, against a wall clock that started at resume
+              self._drift_windows.append((nb - seed_steps, dt))
+              if rec:
+                  tel.record("fit/epoch", tel.now_us() - dt * 1e6,
+                             cat="fit", epoch=epoch, steps=nb)
+              summ = pm.summary()
+              summ["loss"] = pml.sums.get("loss", 0.0) / max(1, nb)
+              summ["epoch_time_s"] = dt
+              summ["samples_per_sec"] = (pm.train_all - seed_samples) / dt \
+                  if dt > 0 else 0.0
+              summ["dispatches"] = float(ep_disp)
+              summ["host_syncs"] = float(ep_sync)
+              history.append(summ)
+              if verbose:
+                  ms = " ".join(f"{k_}={v:.4f}" for k_, v in summ.items()
+                                if k_ not in ("samples", "dispatches",
+                                              "host_syncs"))
+                  print(f"[epoch {epoch}] {ms}")
+              for cb in callbacks or []:
+                  if hasattr(cb, "on_epoch_end"):
+                      cb.on_epoch_end(epoch, summ)
+              if res is not None:
+                  res.epoch_end(epoch, history)
+            if res is not None:
+                res.final_save(epochs, history)
+        finally:
+            if res is not None:
+                res.guard.uninstall()
         return history
 
     def evaluate(self, x, y, batch_size: Optional[int] = None):

@@ -78,6 +78,31 @@ class FFConfig:
     # host on the caller thread (donation-safe), serialization + fsync on a
     # background writer thread; restore/exit wait for pending writes
     async_checkpoint: bool = True
+    # resilience (runtime/resilience.py): durable atomic-commit checkpoints
+    # + preemption-safe shutdown + auto-resume.
+    #   checkpoint_dir — root for durable `ckpt-<step>` snapshots ("" = the
+    #     whole resilience layer is off; fit then carries zero extra work)
+    #   checkpoint_every_steps / checkpoint_every_secs — periodic snapshot
+    #     policy inside fit (both 0 = only the end-of-fit/preemption
+    #     snapshots); either trigger fires a durable save
+    #   resume — "" (fresh start), "auto" (newest committed snapshot under
+    #     checkpoint_dir; corrupt ones are skipped), or an explicit path
+    #   keep_checkpoints — retention: committed snapshots beyond the newest
+    #     N are pruned after each commit (<= 0 keeps everything)
+    checkpoint_dir: str = ""
+    checkpoint_every_steps: int = 0
+    checkpoint_every_secs: float = 0.0
+    resume: str = ""
+    keep_checkpoints: int = 3
+    # transient-fault retry policy (resilience.RetryPolicy.from_config):
+    # bounded attempts + exponential backoff with jitter from the run's
+    # seeded rng, wrapped around dataloader transfers, checkpoint writes,
+    # jax.distributed init and the pipeline boundary hop
+    retry_attempts: int = 3
+    retry_base_delay: float = 0.05
+    # deterministic fault injection (runtime/faults.py plan grammar, e.g.
+    # "dataloader/transfer@3*2,checkpoint/write@1!"); also FF_FAULT_PLAN
+    fault_plan: str = ""
     # zero-redundancy data parallelism (compiler/compile.py): shard the
     # optimizer moments over the batch ("data"/"node") mesh axes instead of
     # replicating them, and rewrite the update as reduce-scatter(grads) ->
@@ -190,6 +215,14 @@ class FFConfig:
         p.add_argument("--dispatch-ahead", type=int, default=32)
         p.add_argument("--async-checkpoint", action=argparse.BooleanOptionalAction,
                        default=True)
+        p.add_argument("--checkpoint-dir", type=str, default="")
+        p.add_argument("--checkpoint-every-steps", type=int, default=0)
+        p.add_argument("--checkpoint-every-secs", type=float, default=0.0)
+        p.add_argument("--resume", type=str, default="")
+        p.add_argument("--keep-checkpoints", type=int, default=3)
+        p.add_argument("--retry-attempts", type=int, default=3)
+        p.add_argument("--retry-base-delay", type=float, default=0.05)
+        p.add_argument("--fault-plan", type=str, default="")
         p.add_argument("--zero-sharding", type=str, default="off",
                        choices=("off", "zero1", "zero2"))
         p.add_argument("--accum-steps", type=int, default=1)
@@ -277,6 +310,14 @@ class FFConfig:
             steps_per_dispatch=args.steps_per_dispatch,
             dispatch_ahead=args.dispatch_ahead,
             async_checkpoint=args.async_checkpoint,
+            checkpoint_dir=args.checkpoint_dir,
+            checkpoint_every_steps=args.checkpoint_every_steps,
+            checkpoint_every_secs=args.checkpoint_every_secs,
+            resume=args.resume,
+            keep_checkpoints=args.keep_checkpoints,
+            retry_attempts=args.retry_attempts,
+            retry_base_delay=args.retry_base_delay,
+            fault_plan=args.fault_plan,
             zero_sharding=args.zero_sharding,
             accum_steps=args.accum_steps,
             pipeline_stages=args.pipeline_stages,

@@ -57,7 +57,10 @@ from flexflow_tpu.losses import LossType, compute_loss
 from flexflow_tpu.metrics import compute_metrics
 from flexflow_tpu.parallel.machine import MachineSpec
 from flexflow_tpu.parallel.sharding import Strategy, dims_to_pspec
+from flexflow_tpu.runtime import faults as _faults
 from flexflow_tpu.runtime.dataloader import SingleDataLoader, group_microbatches
+from flexflow_tpu.runtime.resilience import (RetryPolicy, progress_dict,
+                                             run_resilient, start_state)
 from flexflow_tpu.search import cost_model as cm
 
 
@@ -163,6 +166,7 @@ class PipelinedModel:
         # carry cuts out of order, and stage/boundary pairing assumes
         # ascending topo positions
         self.cuts = sorted(int(c) for c in strategy.pipeline["cuts"])
+        self._retry_policy = RetryPolicy.from_config(self.cfg)
         self._iteration = 0
         self.step_stats: Dict[str, int] = {}
         # drift-monitor windows [(updates, wall_seconds)] per epoch of the
@@ -479,6 +483,26 @@ class PipelinedModel:
     def _put(self, arr, sharding):
         return jax.device_put(arr, sharding)
 
+    def _xfer_in(self, arr, sharding):
+        """Host->device microbatch input transfer (stage 0) — the
+        `dataloader/transfer` retry + fault-injection site on the
+        pipelined path (the flat path's prefetch worker wraps the same
+        site), so a fault plan naming it is never silently inert here."""
+        return run_resilient("dataloader/transfer",
+                             lambda: self._put(arr, sharding),
+                             self._retry_policy)
+
+    def _hop(self, arr, sharding):
+        """Stage-boundary transfer (activation/cotangent resharding hop
+        between sub-meshes) — the `pipe/boundary_hop` retry + fault-
+        injection site, always armed (a transient device_put failure in a
+        real run must get the same backoff the tests exercise). The hop's
+        input is a live (non-donated) array, so a retried device_put
+        re-runs identical work."""
+        return run_resilient("pipe/boundary_hop",
+                             lambda: self._put(arr, sharding),
+                             self._retry_policy)
+
     def _label_sharding(self, label_shape):
         mesh = self.stage_meshes[-1]
         ax = "data" if "data" in mesh.shape else list(mesh.shape)[0]
@@ -518,12 +542,12 @@ class PipelinedModel:
             for (s, ph, m) in row:
                 if ph == "F":
                     if s == 0:
-                        x = [self._put(a[m], sh)
+                        x = [self._xfer_in(a[m], sh)
                              for a, sh in zip(micro_xs, self._in_sh0)]
                     else:
                         # stage graphs take a LIST of inputs; interior
                         # stages have exactly one (the boundary tensor)
-                        x = [self._put(ybuf.pop((s - 1, m)),
+                        x = [self._hop(ybuf.pop((s - 1, m)),
                                        self._bound_in_sh[s - 1])]
                     stash_x[s][m] = x
                     stash_st[s][m] = state[s]
@@ -585,7 +609,7 @@ class PipelinedModel:
                     del stash_x[s][m], stash_st[s][m]
                     if s > 0:
                         # activation-gradient hop back to the upstream group
-                        gybuf[(s - 1, m)] = self._put(
+                        gybuf[(s - 1, m)] = self._hop(
                             gx, self._bound_out_sh[s - 1])
                     acc[s] = gp if acc[s] is None \
                         else self._acc_fns[s](acc[s], gp)
@@ -616,14 +640,23 @@ class PipelinedModel:
     def fit(self, x, y, batch_size: Optional[int] = None,
             epochs: Optional[int] = None, callbacks=None,
             verbose: bool = True, accum_steps: Optional[int] = None,
-            steps_per_dispatch: Optional[int] = None, **_ignored):
+            steps_per_dispatch: Optional[int] = None,
+            resume: Optional[str] = None,
+            checkpoint_dir: Optional[str] = None,
+            checkpoint_every_steps: Optional[int] = None,
+            checkpoint_every_secs: Optional[float] = None, **_ignored):
         """Same contract as CompiledModel.fit; `accum_steps` is the
         microbatch count M the schedule pipelines over (config default).
         steps_per_dispatch is accepted for interface parity — the pipeline
         loop is already fully asynchronous (the host never reads a device
         value mid-epoch), so there is nothing left to fuse; K is recorded
-        in step_stats for observability."""
+        in step_stats for observability. The resilience knobs (durable
+        periodic checkpoints, SIGTERM/SIGINT drain, resume="auto" — see
+        runtime/resilience.py) work exactly as on the flat path; elastic
+        resume composes with the per-layer pipeline checkpoint schema, so
+        a relaunch may use a different stage count or stage mesh."""
         from flexflow_tpu.metrics import PerfMetrics
+        from flexflow_tpu.runtime.resilience import FitResilience
 
         xs = x if isinstance(x, (list, tuple)) else [x]
         if self.stage_params[0] is None:
@@ -640,6 +673,18 @@ class PipelinedModel:
         if M < 1:
             M = 1
         ticks = cm.pipeline_schedule(self.schedule, self.num_stages, M)
+        res = FitResilience.build(self, resume, checkpoint_dir,
+                                  checkpoint_every_steps,
+                                  checkpoint_every_secs)
+        if res is not None:
+            # effective (per-call) knobs define the manifest's progress
+            # units — for save AND the resume-compatibility check
+            res.set_effective(batch_size, M)
+            # ONE policy per fit: the hop/transfer sites (_hop/_xfer_in)
+            # share res's instead of the model-lifetime default, so a
+            # future per-fit retry override reaches every site
+            self._retry_policy = res.policy
+        progress = res.resume_now(verbose) if res is not None else None
         loader = SingleDataLoader(xs, y, batch_size, shuffle=True,
                                   seed=self.cfg.seed)
         lab_sh = self._label_sharding(
@@ -654,58 +699,109 @@ class PipelinedModel:
         self._drift_windows = []
         self._bubble_sum, self._bubble_n = 0.0, 0
         self._fit_id = next(_FIT_SEQ)
-        history = []
-        for epoch in range(epochs):
-            # per-update losses fold into ONE device scalar (bounded
-            # memory on long epochs — each add consumes its predecessor),
-            # materialized at epoch end only (the async-loop contract)
-            loss_sum = None
-            pm = PerfMetrics()
-            t0 = time.perf_counter()
-            nb = 0
-            for gxs, gy in group_microbatches(loader.epoch(), M):
-                if M == 1:
-                    gxs = [a[None] for a in gxs]
-                    gy = gy[None]
-                rng_iter = jax.random.fold_in(base_rng, self._iteration)
-                loss, mvals = self._pipeline_step(gxs, gy, lab_sh,
-                                                  rng_iter, ticks, M)
-                loss_sum = loss if loss_sum is None else loss_sum + loss
-                pm.update_deferred(batch_size * M, mvals)
-                self._iteration += 1
-                nb += 1
-                stats["updates"] += 1
-                stats["microbatches"] += M
-                if nb % ahead == 0:
-                    # bounded dispatch-ahead (the PR-2 fit-loop contract):
-                    # don't let the host enqueue unboundedly many stage
-                    # dispatches past the devices
-                    jax.block_until_ready(loss)
-                    stats["barriers"] = stats.get("barriers", 0) + 1
-            dt = time.perf_counter() - t0
-            self._drift_windows.append((nb, dt))
-            if self._bubble_n:
-                # mean of per-update executed-timeline bubbles so far
-                # (telemetry mode only — the async path has no honest
-                # per-op completion times to derive one from)
-                stats["measured_bubble"] = self._bubble_sum / self._bubble_n
-            if tel.enabled():
-                tel.record("fit/epoch", tel.now_us() - dt * 1e6, cat="fit",
-                           epoch=epoch, steps=nb)
-            summ = pm.summary()
-            summ["loss"] = float(np.asarray(loss_sum)) / nb if nb else 0.0
-            summ["epoch_time_s"] = dt
-            summ["samples_per_sec"] = (nb * M * batch_size) / dt \
-                if dt > 0 else 0.0
-            summ["dispatches"] = float(nb)
-            history.append(summ)
-            if verbose:
-                ms = " ".join(f"{k}={v:.4f}" for k, v in summ.items()
-                              if k != "samples")
-                print(f"[epoch {epoch}] {ms}")
-            for cb in callbacks or []:
-                if hasattr(cb, "on_epoch_end"):
-                    cb.on_epoch_end(epoch, summ)
+        start_epoch, skip_steps, history = start_state(progress)
+        if progress:
+            loader.advance_epochs(start_epoch)
+        faults_on = _faults.active()
+        if res is not None:
+            res.install_guard()
+        try:
+            for epoch in range(start_epoch, epochs):
+              # per-update losses fold into ONE device scalar (bounded
+              # memory on long epochs — each add consumes its predecessor),
+              # materialized at epoch end only (the async-loop contract)
+              loss_sum = None
+              pm = PerfMetrics()
+              t0 = time.perf_counter()
+              nb = 0
+              seed_steps = 0  # see the flat loop: resumed steps are not
+              resuming = epoch == start_epoch and progress  # this session's work
+              # resume mid-epoch: the loader fast-forwards past the
+              # consumed accumulation groups' microbatches without
+              # gathering them; accumulators re-seed (see the flat loop)
+              grouped = group_microbatches(
+                  loader.epoch(skip_batches=skip_steps * M
+                               if resuming else 0), M)
+              if resuming:
+                  nb = seed_steps = skip_steps
+                  if progress.get("loss_sum") is not None and nb:
+                      # a host float: `float + device scalar` promotes onto
+                      # the last stage's devices (a seeded jnp array would
+                      # live on the default device — a cross-mesh add)
+                      loss_sum = float(progress["loss_sum"])
+                  pm.sums = {mk: float(mv) for mk, mv in
+                             (progress.get("metric_sums") or {}).items()}
+                  pm.train_all = int(progress.get("samples", 0))
+
+              def make_progress(_pm=pm, _epoch=epoch):
+                  # durable progress counters for res.maybe_checkpoint
+                  # (reads nb/loss_sum/history at call time)
+                  _pm.materialize()
+                  return progress_dict(_epoch, nb,
+                                       float(np.asarray(loss_sum))
+                                       if loss_sum is not None else 0.0,
+                                       _pm.sums, _pm.train_all, history)
+
+              for gxs, gy in grouped:
+                  if M == 1:
+                      gxs = [a[None] for a in gxs]
+                      gy = gy[None]
+                  if faults_on:
+                      # fit/dispatch admission BEFORE the update (nothing
+                      # consumed yet, retry-safe); one pipelined update =
+                      # one global step, so index = 1-based step, same
+                      # contract as the flat loop
+                      run_resilient("fit/dispatch", lambda: None,
+                                    self._retry_policy,
+                                    index=self._iteration + 1)
+                  rng_iter = jax.random.fold_in(base_rng, self._iteration)
+                  loss, mvals = self._pipeline_step(gxs, gy, lab_sh,
+                                                    rng_iter, ticks, M)
+                  loss_sum = loss if loss_sum is None else loss_sum + loss
+                  pm.update_deferred(batch_size * M, mvals)
+                  self._iteration += 1
+                  nb += 1
+                  stats["updates"] += 1
+                  stats["microbatches"] += M
+                  if nb % ahead == 0:
+                      # bounded dispatch-ahead (the PR-2 fit-loop contract):
+                      # don't let the host enqueue unboundedly many stage
+                      # dispatches past the devices
+                      jax.block_until_ready(loss)
+                      stats["barriers"] = stats.get("barriers", 0) + 1
+                  if res is not None:
+                      res.maybe_checkpoint(loss, make_progress)
+              dt = time.perf_counter() - t0
+              self._drift_windows.append((nb - seed_steps, dt))
+              if self._bubble_n:
+                  # mean of per-update executed-timeline bubbles so far
+                  # (telemetry mode only — the async path has no honest
+                  # per-op completion times to derive one from)
+                  stats["measured_bubble"] = self._bubble_sum / self._bubble_n
+              if tel.enabled():
+                  tel.record("fit/epoch", tel.now_us() - dt * 1e6, cat="fit",
+                             epoch=epoch, steps=nb)
+              summ = pm.summary()
+              summ["loss"] = float(np.asarray(loss_sum)) / nb if nb else 0.0
+              summ["epoch_time_s"] = dt
+              summ["samples_per_sec"] = ((nb - seed_steps) * M * batch_size) \
+                  / dt if dt > 0 else 0.0
+              summ["dispatches"] = float(nb)
+              history.append(summ)
+              if verbose:
+                  ms = " ".join(f"{k}={v:.4f}" for k, v in summ.items()
+                                if k != "samples")
+                  print(f"[epoch {epoch}] {ms}")
+              for cb in callbacks or []:
+                  if hasattr(cb, "on_epoch_end"):
+                      cb.on_epoch_end(epoch, summ)
+              if res is not None:
+                  res.epoch_end(epoch, history)
+            if res is not None:
+                res.final_save(epochs, history)
+        finally:
+            if res is not None:
+                res.guard.uninstall()
         self._fit_end_report(verbose)
         return history
 

@@ -39,6 +39,88 @@ def _ckpt_dir(path: str) -> str:
     return os.path.abspath(path)
 
 
+# ------------------------------------------------------- model fingerprints
+class CheckpointMismatchError(ValueError):
+    """The checkpoint was written by a DIFFERENT model/optimizer than the
+    restore target (graph layers, optimizer state schema, or flat-vs-
+    pipeline format). Raised by the restore paths after comparing the
+    saved fingerprint against the live model — a clear diff instead of
+    the deep orbax/pytree structure error the mismatch would otherwise
+    produce (ISSUE 6 satellite)."""
+
+
+def _graph_fingerprint(model) -> Dict[str, str]:
+    """Per-WEIGHTED-layer digest of the training-state schema: op type +
+    each weight's (name, shape, dtype). Keyed by layer name so a mismatch
+    can LIST the differing layers. Weight-less layers (reshape, flat, ...)
+    contribute nothing to the checkpoint tree and their auto-generated
+    names carry a process-global counter — fingerprinting them would make
+    two identical models built in one process falsely mismatch."""
+    import hashlib
+
+    out = {}
+    for l in model.layers:
+        if not l.weight_specs:
+            continue
+        desc = f"{l.op_type.value}|" + ";".join(
+            f"{w}:{tuple(sp.shape)}:{sp.dtype}"
+            for w, sp in sorted(l.weight_specs.items()))
+        out[l.name] = hashlib.sha1(desc.encode()).hexdigest()[:10]
+    return out
+
+
+def model_fingerprint(model) -> Dict[str, Any]:
+    """What a checkpoint structurally depends on: graph (per-layer weight
+    schema), optimizer (state-tree shape), and format (flat CompiledModel
+    vs pipeline). Saved into meta.json; the restore paths diff it against
+    the live model. Hyperparameters (lr, betas) are deliberately NOT
+    fingerprinted — resuming with a new schedule is legitimate."""
+    opt = model.optimizer
+    return {
+        "format": "pipeline" if hasattr(model, "stage_params") else "flat",
+        "graph": _graph_fingerprint(model.model),
+        "optimizer": {
+            "class": type(opt).__name__,
+            "moments": int(opt.moment_count()),
+            "state_dtype": str(getattr(opt, "state_dtype", None)
+                               or "float32"),
+        },
+    }
+
+
+def _validate_fingerprint(meta: Dict[str, Any], live: Dict[str, Any],
+                          path: str) -> None:
+    saved = meta.get("fingerprint")
+    if not saved:  # pre-fingerprint checkpoint: nothing to validate against
+        return
+    diffs: List[str] = []
+    if saved.get("format") != live["format"]:
+        diffs.append(f"format: checkpoint={saved.get('format')} "
+                     f"model={live['format']}")
+    sg = dict(saved.get("graph") or {})
+    lg = live["graph"]
+    only_ck = sorted(set(sg) - set(lg))
+    only_live = sorted(set(lg) - set(sg))
+    changed = sorted(k for k in set(sg) & set(lg) if sg[k] != lg[k])
+    if only_ck:
+        diffs.append(f"graph: layers only in checkpoint: {only_ck[:8]}")
+    if only_live:
+        diffs.append(f"graph: layers only in model: {only_live[:8]}")
+    if changed:
+        diffs.append("graph: layers with different weight schema "
+                     f"(op/shape/dtype): {changed[:8]}")
+    so = dict(saved.get("optimizer") or {})
+    lo = live["optimizer"]
+    for k in ("class", "moments", "state_dtype"):
+        if so.get(k) != lo.get(k):
+            diffs.append(f"optimizer {k}: checkpoint={so.get(k)!r} "
+                         f"model={lo.get(k)!r}")
+    if diffs:
+        raise CheckpointMismatchError(
+            f"checkpoint {path} does not match the model:\n  "
+            + "\n  ".join(diffs))
+
+
 # ------------------------------------------------------- async write registry
 _PENDING: Dict[str, "_AsyncSave"] = {}
 _PENDING_LOCK = threading.Lock()
@@ -78,7 +160,27 @@ def report_failed_writes() -> List[str]:
             for f in failed_writes()]
 
 
+def active_writes(prefix: Optional[str] = None) -> List[str]:
+    """Paths of async writes whose writer thread is STILL RUNNING
+    (failed-but-unreported handles don't count). The periodic durable-save
+    backpressure check (resilience.FitResilience.maybe_checkpoint): a new
+    snapshot is skipped while the previous one is still serializing, so a
+    save slower than its trigger interval can't pile up writer threads
+    each holding a full host copy of the state."""
+    with _PENDING_LOCK:
+        items = list(_PENDING.items())
+    return [p for p, h in items
+            if (not prefix or p.startswith(prefix))
+            and h._thread is not None and h._thread.is_alive()]
+
+
 _EXIT_HOOKED = False
+
+# a wedged writer thread (hung filesystem, stuck orbax future) must not
+# hang interpreter shutdown — or a later fit(resume=...) — forever: the
+# exit drain and the resume-time drain bound their joins with this and
+# report instead of blocking
+DRAIN_TIMEOUT = float(os.environ.get("FF_CKPT_EXIT_TIMEOUT", "120"))
 
 
 def _wait_pending_at_exit():
@@ -86,10 +188,23 @@ def _wait_pending_at_exit():
     # before interpreter exit would be killed mid-serialize and leave a
     # silently truncated checkpoint directory
     try:
-        wait_pending()
+        wait_pending(timeout=DRAIN_TIMEOUT)
+    except TimeoutError as e:
+        # NOT silent: a merely-slow (not wedged) write abandoned here is
+        # killed mid-serialize with the daemon thread — name every
+        # possibly-truncated path so nobody trusts those dirs (durable
+        # saves stay safe behind the .tmp-* rename; plain ones do not)
+        logging.getLogger("flexflow_tpu").error(
+            "exit drain timed out (%s); abandoned write(s) may be "
+            "TRUNCATED: %s — raise FF_CKPT_EXIT_TIMEOUT to wait longer",
+            e, active_writes() or "<none>")
     except Exception as e:
         logging.getLogger("flexflow_tpu").error(
             "async checkpoint write failed at exit: %s", e)
+    finally:
+        # a write that fails DURING interpreter shutdown has no later
+        # fit-end summary to surface it — report here or it vanishes
+        warn_failed_writes(verbose=True)
 
 
 def _register_exit_drain():
@@ -168,9 +283,18 @@ class _AsyncSave:
         return self.path
 
 
-def wait_pending(path: Optional[str] = None) -> None:
-    """Join in-flight async checkpoint writes (all, or just `path`'s),
-    re-raising the first write error."""
+def wait_pending(path: Optional[str] = None,
+                 timeout: Optional[float] = None) -> None:
+    """Join in-flight async checkpoint writes (all, or just `path`'s).
+    EVERY handle is joined before the first error re-raises — aborting on
+    the first failure would abandon the remaining writer threads, and at
+    interpreter exit the abandoned daemons get killed mid-serialize
+    (truncated checkpoints, the exact outcome the drain exists to
+    prevent). `timeout` bounds the TOTAL wait across handles
+    (TimeoutError past it, the write keeps running) — the exit drain and
+    resume use it so a wedged writer thread can't hang forever."""
+    import time as _time
+
     with _PENDING_LOCK:
         if path is None:
             handles: List[_AsyncSave] = list(_PENDING.values())
@@ -179,10 +303,33 @@ def wait_pending(path: Optional[str] = None) -> None:
             handles = [h] if h is not None else []
     if not handles:
         return
+    deadline = None if timeout is None else _time.monotonic() + timeout
+    first_exc: Optional[BaseException] = None
     with tel.span("checkpoint/drain", cat="checkpoint",
                   pending=len(handles)):
         for h in handles:
-            h.result()
+            remaining = None if deadline is None \
+                else max(0.0, deadline - _time.monotonic())
+            try:
+                h.result(timeout=remaining)
+            except BaseException as e:
+                # Real write failures outrank TimeoutError (a wedged
+                # handle must not mask a genuinely LOST checkpoint from
+                # the caller — resume treats a timeout as "proceed from
+                # committed snapshots" but a failure must surface).
+                if first_exc is None or (isinstance(first_exc, TimeoutError)
+                                         and h._exc is not None):
+                    first_exc = e
+                elif h._exc is not None:
+                    # not re-raised to the caller; result() consumed the
+                    # registry entry on the assumption the caller sees
+                    # it — put it back so the failed write stays visible
+                    # (warn_failed_writes / the exit report).
+                    with _PENDING_LOCK:
+                        _FAILED.append({"path": h.path, "error": repr(e),
+                                        "handle": h})
+    if first_exc is not None:
+        raise first_exc
 
 
 # ------------------------------------------------------------------ save/load
@@ -203,7 +350,46 @@ def _write_tree(ckptr, path: str, tree: Dict[str, Any], meta: Dict[str, Any],
             np.savez(os.path.join(path, "state.npz"), **state)
 
 
-def save_checkpoint(cm, path: str, block: bool = True) -> str:
+def _start_write(path: str, block: bool, write_fn, commit,
+                 retry_policy) -> str:
+    """Shared tail of the save paths: run `write_fn` (the expensive orbax
+    serialization) under the checkpoint/write retry + fault-injection
+    site, then `commit` (the durable-snapshot rename protocol from
+    runtime/resilience.py — None for plain checkpoints). Sync callers run
+    it inline; async ones hand it to the writer thread, so the COMMIT
+    also happens there (wait_pending()/the exit drain joins it and a
+    commit failure lands in failed_writes())."""
+    from flexflow_tpu.runtime.resilience import run_resilient
+
+    def write_and_commit():
+        # write AND commit under ONE checkpoint/write retry invocation
+        # (one fault index per save): a transient fault in the commit's
+        # fsync/rename would otherwise permanently strand the finished
+        # orbax write as an undiscoverable .tmp-*. The retry re-runs both
+        # halves — write_fn is force=True-idempotent and commit no-ops
+        # once the rename has happened.
+        def _wc():
+            write_fn()
+            if commit is not None:
+                commit()
+
+        run_resilient("checkpoint/write", _wc, retry_policy)
+
+    if block:
+        with tel.span("checkpoint/write", cat="checkpoint", path=path,
+                      blocking=True):
+            write_and_commit()
+        return path
+    _register_exit_drain()
+    handle = _AsyncSave(path)
+    with _PENDING_LOCK:
+        _PENDING[path] = handle
+    handle.start(write_and_commit)
+    return path
+
+
+def save_checkpoint(cm, path: str, block: bool = True, commit=None,
+                    retry_policy=None) -> str:
     """Persist a CompiledModel's full training state (params, optimizer
     state, BN/running state, iteration, strategy) under `path`.
 
@@ -211,7 +397,8 @@ def save_checkpoint(cm, path: str, block: bool = True) -> str:
     returns as soon as the state is snapshot to host; the write happens on
     a background thread. Multi-process runs always write synchronously —
     the per-process shards aren't host-gatherable, and orbax coordinates
-    the processes itself."""
+    the processes itself. `commit` (durable snapshots) runs after the
+    write completes, on whichever thread wrote."""
     import orbax.checkpoint as ocp
 
     path = _ckpt_dir(path)
@@ -225,34 +412,75 @@ def save_checkpoint(cm, path: str, block: bool = True) -> str:
         # stores GLOBAL arrays, so the re-shard is just a different slicing)
         "mesh_axes": dict(cm.machine.mesh_axes),
         "zero_sharding": getattr(cm.cfg, "zero_sharding", "off"),
+        "fingerprint": model_fingerprint(cm),
     }
     state = {k: np.asarray(v) for k, v in cm.state.items()}
     tree = {"params": cm.params, "opt_state": cm.opt_state}
     ckptr = ocp.StandardCheckpointer()  # caller thread: see _write_tree
     if block or jax.process_count() > 1:
-        with tel.span("checkpoint/write", cat="checkpoint", path=path,
-                      blocking=True):
-            _write_tree(ckptr, path, tree, meta, state)
-        return path
+        return _start_write(
+            path, True, lambda: _write_tree(ckptr, path, tree, meta, state),
+            commit, retry_policy)
     # copy-then-write: D2H snapshot here (donation-safe — the live buffers
     # may be consumed by the next train_step), serialization off-thread
     with tel.span("checkpoint/snapshot", cat="checkpoint", path=path):
         host_tree = jax.tree_util.tree_map(np.asarray, tree)
-    _register_exit_drain()
-    handle = _AsyncSave(path)
-    with _PENDING_LOCK:
-        _PENDING[path] = handle
-    handle.start(lambda: _write_tree(ckptr, path, host_tree, meta, state))
-    return path
+    return _start_write(
+        path, False,
+        lambda: _write_tree(ckptr, path, host_tree, meta, state),
+        commit, retry_policy)
 
 
-def save_pipeline_checkpoint(pm, path: str, block: bool = True) -> str:
+def _split_opt_by_layer(opt_tree, stage_params):
+    """Transpose one stage's optax state into {layer_name: per-layer opt
+    tree}: every params-shaped subtree inside the state (Adam's mu/nu,
+    SGD's momentum trace) is replaced by its single layer's {w: leaf}
+    dict, and non-param leaves (step counts — tiny scalars, identical
+    across stages) are duplicated into every layer's tree. This makes the
+    checkpoint's optimizer schema STAGE-PARTITION-FREE, so a snapshot
+    saved at S=2 restores onto S=4 (elastic resume across stage counts —
+    ISSUE 6): stage ownership is a placement detail, exactly like the
+    merged params tree."""
+    pstruct = jax.tree_util.tree_structure(stage_params)
+    if pstruct.num_leaves == 0:  # no weighted layers in this stage
+        return {}
+
+    def is_sub(x):
+        return jax.tree_util.tree_structure(x) == pstruct
+
+    return {ln: jax.tree_util.tree_map(
+                lambda sub, _ln=ln: sub[_ln] if is_sub(sub) else sub,
+                opt_tree, is_leaf=is_sub)
+            for ln in stage_params}
+
+
+def _join_opt_by_layer(per_layer, stage_params, template):
+    """Inverse of _split_opt_by_layer for ONE (possibly different) stage
+    partition: recombine the per-layer opt trees of `stage_params`' layers
+    into the stage's optax state, using the live `template` (tx.init
+    structure) to locate the params-shaped subtree positions. Non-param
+    leaves take the first layer's duplicated copy."""
+    pstruct = jax.tree_util.tree_structure(stage_params)
+    names = list(stage_params)
+
+    def is_sub(x):
+        return jax.tree_util.tree_structure(x) == pstruct
+
+    trees = [per_layer[ln] for ln in names]
+    return jax.tree_util.tree_map(
+        lambda tsub, *subs: ({ln: s for ln, s in zip(names, subs)}
+                             if is_sub(tsub) else subs[0]),
+        template, *trees, is_leaf=is_sub)
+
+
+def save_pipeline_checkpoint(pm, path: str, block: bool = True, commit=None,
+                             retry_policy=None) -> str:
     """Checkpoint a PipelinedModel (parallel/pipeline.py): params are saved
     as ONE logical tree keyed by layer name (stage ownership is a placement
-    detail, not a schema detail), optimizer state per stage. Restoring onto
-    a different stage-internal mesh (e.g. data=4 -> data=2 per stage) is
-    the same global-array re-shard the flat path does; the stage COUNT must
-    match (the per-stage optax state trees key on it)."""
+    detail, not a schema detail) and the optimizer state PER LAYER (the
+    _split_opt_by_layer transposition) — so restore re-shards onto a
+    different stage-internal mesh (data=4 -> data=2 per stage) AND onto a
+    different stage count/cut set (S=4 -> S=2 elastic resume)."""
     import orbax.checkpoint as ocp
 
     path = _ckpt_dir(path)
@@ -264,36 +492,40 @@ def save_pipeline_checkpoint(pm, path: str, block: bool = True) -> str:
         "pipeline": {"stages": pm.num_stages, "schedule": pm.schedule,
                      "cuts": list(pm.cuts)},
         "zero_sharding": getattr(pm.cfg, "zero_sharding", "off"),
+        "opt_schema": "per-layer",
+        "fingerprint": model_fingerprint(pm),
     }
-    tree = {"params": pm.merged_params(),
-            "opt_state": {f"stage{s}": pm.stage_opt[s]
-                          for s in range(pm.num_stages)}}
+    opt_by_layer = {}
+    for s in range(pm.num_stages):
+        opt_by_layer.update(
+            _split_opt_by_layer(pm.stage_opt[s], pm.stage_params[s]))
+    tree = {"params": pm.merged_params(), "opt_state": opt_by_layer}
     # non-trainable state merges like params: keys are "{layer.name}/..."
     # so restore re-derives stage ownership from the layer-name prefix
     state = {k: np.asarray(v) for d in pm.stage_state for k, v in d.items()}
     ckptr = ocp.StandardCheckpointer()
     if block or jax.process_count() > 1:
-        with tel.span("checkpoint/write", cat="checkpoint", path=path,
-                      blocking=True):
-            _write_tree(ckptr, path, tree, meta, state)
-        return path
+        return _start_write(
+            path, True, lambda: _write_tree(ckptr, path, tree, meta, state),
+            commit, retry_policy)
     with tel.span("checkpoint/snapshot", cat="checkpoint", path=path):
         host_tree = jax.tree_util.tree_map(np.asarray, tree)
-    _register_exit_drain()
-    handle = _AsyncSave(path)
-    with _PENDING_LOCK:
-        _PENDING[path] = handle
-    handle.start(lambda: _write_tree(ckptr, path, host_tree, meta, state))
-    return path
+    return _start_write(
+        path, False,
+        lambda: _write_tree(ckptr, path, host_tree, meta, state),
+        commit, retry_policy)
 
 
 def restore_pipeline_checkpoint(pm, path: str) -> None:
     """Restore a pipeline checkpoint into a PipelinedModel built from the
-    same model graph, stage count and cuts. Each param lands on the stage
-    owning its layer, in the restoring stage-mesh's sharding — so a
-    checkpoint saved under {data: 4} stages restores onto {data: 2} stages
-    (cross-mesh re-shard of stage-sharded state). The cuts must match: the
-    per-stage optax state trees embed the stage's layer partition."""
+    same model graph. Each param lands on the stage owning its layer, in
+    the restoring stage-mesh's sharding — so a checkpoint saved under
+    {data: 4} stages restores onto {data: 2} stages (cross-mesh re-shard
+    of stage-sharded state) AND, because the optimizer state is stored
+    per layer (opt_schema "per-layer"), onto a DIFFERENT stage count or
+    cut set (elastic resume after relaunch on a smaller machine). A
+    wrong-model checkpoint fails with CheckpointMismatchError before any
+    orbax work."""
     import orbax.checkpoint as ocp
     from jax.sharding import NamedSharding, PartitionSpec
 
@@ -303,27 +535,36 @@ def restore_pipeline_checkpoint(pm, path: str) -> None:
         pm.init()
     with open(os.path.join(path, "meta.json")) as f:
         meta = json.load(f)
+    _validate_fingerprint(meta, model_fingerprint(pm), path)
     saved = meta.get("pipeline", {})
-    if saved.get("stages") != pm.num_stages:
-        raise ValueError(
-            f"checkpoint has {saved.get('stages')} pipeline stages, model "
-            f"has {pm.num_stages}: per-stage optimizer state cannot be "
-            "re-keyed across stage counts")
-    if sorted(saved.get("cuts", [])) != sorted(pm.cuts):
-        raise ValueError(
-            f"checkpoint stage cuts {saved.get('cuts')} != model cuts "
-            f"{list(pm.cuts)}: the per-stage optax state trees embed the "
-            "stage's layer partition (orbax would fail on the structure "
-            "mismatch anyway — failing cleanly here)")
+    if meta.get("opt_schema") != "per-layer":
+        raise CheckpointMismatchError(
+            f"checkpoint {path} uses the legacy stage-keyed optimizer "
+            f"schema (stages={saved.get('stages')} cuts={saved.get('cuts')})"
+            "; this version stores pipeline optimizer state per layer — "
+            "re-save the checkpoint to restore (and to get elastic "
+            "stage-count restore)")
+    if saved.get("stages") != pm.num_stages or \
+            sorted(saved.get("cuts", [])) != sorted(pm.cuts):
+        logging.getLogger("flexflow_tpu").info(
+            "pipeline checkpoint %s saved with stages=%s cuts=%s, "
+            "restoring onto stages=%s cuts=%s (elastic re-key)", path,
+            saved.get("stages"), saved.get("cuts"), pm.num_stages,
+            list(pm.cuts))
     if dict(meta.get("mesh_axes", {})) != dict(pm.stage_machine.mesh_axes):
         logging.getLogger("flexflow_tpu").info(
             "pipeline checkpoint %s saved on stage mesh %s, restoring "
             "onto %s (re-shard)", path, meta.get("mesh_axes"),
             dict(pm.stage_machine.mesh_axes))
     ckptr = ocp.StandardCheckpointer()
-    target = {"params": pm.merged_params(),
-              "opt_state": {f"stage{s}": pm.stage_opt[s]
-                            for s in range(pm.num_stages)}}
+    # targets carry the NEW partition's live shardings; the saved tree is
+    # keyed by layer name on both sides, so stage count never appears in
+    # the schema
+    target_opt = {}
+    for s in range(pm.num_stages):
+        target_opt.update(
+            _split_opt_by_layer(pm.stage_opt[s], pm.stage_params[s]))
+    target = {"params": pm.merged_params(), "opt_state": target_opt}
     restored = ckptr.restore(os.path.join(path, "tree"), target)
 
     def _placed(r, t, mesh):
@@ -337,9 +578,13 @@ def restore_pipeline_checkpoint(pm, path: str) -> None:
         pm.stage_params[s] = jax.tree_util.tree_map(
             lambda r, t, _m=pm.stage_meshes[s]: _placed(r, t, _m),
             {ln: restored["params"][ln] for ln in live}, live)
+        if jax.tree_util.tree_structure(live).num_leaves == 0:
+            continue  # weight-less stage: keep its (empty) live opt state
+        joined = _join_opt_by_layer(restored["opt_state"], live,
+                                    pm.stage_opt[s])
         pm.stage_opt[s] = jax.tree_util.tree_map(
             lambda r, t, _m=pm.stage_meshes[s]: _placed(r, t, _m),
-            restored["opt_state"][f"stage{s}"], pm.stage_opt[s])
+            joined, pm.stage_opt[s])
     pm._iteration = int(meta.get("iteration", 0))
     state_file = os.path.join(path, "state.npz")
     if os.path.exists(state_file):
@@ -370,6 +615,7 @@ def restore_checkpoint(cm, path: str) -> None:
         cm.init()
     with open(os.path.join(path, "meta.json")) as f:
         meta = json.load(f)
+    _validate_fingerprint(meta, model_fingerprint(cm), path)
     saved_mesh = meta.get("mesh_axes")
     if saved_mesh and dict(saved_mesh) != dict(cm.machine.mesh_axes):
         # mesh changed between save and restore (e.g. ZeRO moments saved

@@ -55,13 +55,30 @@ class SingleDataLoader:
                 return out
         return arr[idx]
 
-    def epoch(self) -> Iterator[Tuple[List[np.ndarray], np.ndarray]]:
+    def epoch(self, skip_batches: int = 0,
+              ) -> Iterator[Tuple[List[np.ndarray], np.ndarray]]:
+        """One shuffled pass. `skip_batches` resumes MID-epoch (the
+        auto-resume cursor): the shuffle still draws the full permutation
+        (identical rng consumption to a skip-less epoch), but the skipped
+        leading batches are never gathered — an O(1) fast-forward instead
+        of materializing and discarding thousands of batches."""
         order = np.arange(self.num_samples)
         if self.shuffle:
             self.rng.shuffle(order)
-        for b in range(self.num_batches):
+        for b in range(max(0, int(skip_batches)), self.num_batches):
             idx = order[b * self.batch_size:(b + 1) * self.batch_size]
             yield [self._take(x, idx) for x in self.xs], self._take(self.y, idx)
+
+    def advance_epochs(self, n: int) -> None:
+        """Fast-forward the shuffle rng past `n` epochs WITHOUT touching
+        data — the auto-resume dataloader cursor (runtime/resilience.py):
+        a relaunched fit rebuilds the loader with the run's seed, advances
+        past the completed epochs, and the next epoch() draws the exact
+        permutation the interrupted run was consuming. Must mirror
+        epoch()'s rng consumption (one shuffle per epoch) exactly."""
+        for _ in range(max(0, int(n))):
+            if self.shuffle:
+                self.rng.shuffle(np.arange(self.num_samples))
 
 
 def _batch_shapes(xs, y):
@@ -97,19 +114,20 @@ def group_microbatches(it, n: int):
 
 
 def prefetch_to_device(it, input_shardings, label_sharding, depth: int = 2,
-                       put=None):
+                       put=None, retry_policy=None):
     """Overlap host→device transfer with compute (double buffering).
     `put(arr, sharding)` overrides the transfer (multi-host runs pass the
     global-array assembler from runtime/distributed.py). Implemented as
     the k=1 case of prefetch_multi, untagged."""
     for _kind, dx, dy in prefetch_multi(it, 1, input_shardings,
-                                        label_sharding, depth=depth, put=put):
+                                        label_sharding, depth=depth, put=put,
+                                        retry_policy=retry_policy):
         yield dx, dy
 
 
 def prefetch_multi(it, k, input_shardings, label_sharding,
                    stacked_input_shardings=None, stacked_label_sharding=None,
-                   depth: int = 2, put=None):
+                   depth: int = 2, put=None, retry_policy=None):
     """K-step prefetcher for the fused-dispatch training loop
     (CompiledModel.make_multi_step): groups `k` consecutive host batches,
     np.stacks them into (k, ...) arrays, and transfers each group with the
@@ -122,7 +140,16 @@ def prefetch_multi(it, k, input_shardings, label_sharding,
     rather than crashing np.stack). With k <= 1 it degenerates to tagged
     prefetch_to_device. Worker exceptions are forwarded to the consumer
     like prefetch_to_device (the queued items ahead of the exception still
-    drain first)."""
+    drain first).
+
+    Transfers run under the retry/backoff + fault-injection site
+    `dataloader/transfer` (runtime/resilience.py): a transient device_put
+    failure — the tunnel transport's bread and butter — is retried with
+    backoff inside the worker thread instead of killing the epoch;
+    `retry_policy` defaults to the module default (fit passes the
+    config-derived policy)."""
+    from flexflow_tpu.runtime.resilience import run_resilient
+
     q: "queue_mod.Queue" = queue_mod.Queue(maxsize=depth)
     _DONE = object()
     if put is None:
@@ -133,9 +160,14 @@ def prefetch_multi(it, k, input_shardings, label_sharding,
 
     def _xfer(xs, y, in_sh, lab_sh):
         t0 = tel.now_us() if rec else 0.0
-        dx = [put(x, s) if s is not None else jax.device_put(x)
-              for x, s in zip(xs, in_sh)]
-        dy = put(y, lab_sh) if lab_sh is not None else jax.device_put(y)
+
+        def move():
+            dx = [put(x, s) if s is not None else jax.device_put(x)
+                  for x, s in zip(xs, in_sh)]
+            dy = put(y, lab_sh) if lab_sh is not None else jax.device_put(y)
+            return dx, dy
+
+        dx, dy = run_resilient("dataloader/transfer", move, retry_policy)
         if rec:
             tel.record("dataloader/transfer", t0, cat="dataloader")
         return dx, dy

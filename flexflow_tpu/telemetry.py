@@ -189,6 +189,15 @@ def error(name: str, **args: Any) -> None:
     event(name, cat="error", **args)
 
 
+def retry(site: str, attempt: int, exc: BaseException, **args: Any) -> None:
+    """Instant event in the reserved "retry" category — one per backoff
+    retry of a transient fault (runtime/resilience.run_resilient).
+    tests/test_resilience.py asserts these appear for every recovered
+    injected fault; exhaustion lands in the "error" category instead."""
+    event("retry", cat="retry", site=site, attempt=attempt,
+          error=repr(exc), **args)
+
+
 def counter(name: str, value: float, cat: Optional[str] = None) -> None:
     """Counter sample (Chrome ph "C") — e.g. dataloader queue occupancy."""
     s = _SINK

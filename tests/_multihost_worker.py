@@ -1,9 +1,14 @@
 """Worker for the 2-process multi-host test (mpi_wrapper analog) — run by
 tests/test_multihost.py, one subprocess per "host", each with 4 virtual CPU
-devices; jax.distributed stitches them into one 8-device world."""
+devices; jax.distributed stitches them into one 8-device world. CPU
+cross-process collectives ride gloo (init_distributed flips
+jax_cpu_collectives_implementation — without it jax >= 0.4.x fails with
+"Multiprocess computations aren't implemented on the CPU backend")."""
 
 import os
 import sys
+import threading
+import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -15,10 +20,39 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 
+
+_PHASE = "start"
+
+
+def phase(name):
+    # main-thread progress marker: the parent's watchdog treats a rank
+    # whose heartbeat PHASE stops advancing as hung — an unconditional
+    # beat would keep ticking right through a coordinator deadlock or a
+    # wedged collective (the heartbeat thread doesn't need the main
+    # thread to run)
+    global _PHASE
+    _PHASE = name
+    print(f"PHASE {name}", flush=True)
+
+
+def _heartbeat():
+    n = 0
+    while True:
+        print(f"HB pid={pid} ph={_PHASE} n={n}", flush=True)
+        n += 1
+        time.sleep(2.0)
+
+
+threading.Thread(target=_heartbeat, daemon=True).start()
+
 from flexflow_tpu.runtime.distributed import init_distributed, is_multiprocess
 
+# retry-with-backoff lives inside init_distributed (the distributed/init
+# resilience site): a worker that races the coordinator's socket retries
+phase("init_distributed")
 init_distributed(coordinator_address=f"127.0.0.1:{port}",
                  num_processes=nproc, process_id=pid)
+phase("init_done")
 
 assert jax.process_count() == nproc, jax.process_count()
 assert jax.device_count() == 4 * nproc, jax.device_count()
@@ -35,15 +69,18 @@ m = FFModel(cfg)
 x = m.create_tensor([32, 16], name="x")
 h = m.dense(x, 64, activation="relu", name="fc1")
 m.dense(h, 4, name="head")
+phase("compile")
 cm = m.compile(SGDOptimizer(lr=0.05),
                loss_type="sparse_categorical_crossentropy", metrics=[])
 cm.init(seed=0)
+phase("fit")
 
 rng = np.random.default_rng(0)  # identical dataset on every process
 xv = rng.normal(size=(128, 16)).astype(np.float32)
 w = rng.normal(size=(16, 4)).astype(np.float32)
 yv = np.argmax(xv @ w, axis=1).astype(np.int32)
 hist = cm.fit(xv, yv, verbose=False)
+phase("evaluate")
 losses = [h["loss"] for h in hist]
 assert all(np.isfinite(l) for l in losses), losses
 assert losses[-1] < losses[0], losses
@@ -58,6 +95,7 @@ assert local.shape == (16, 4) and np.isfinite(local).all()
 # both ranks must call save/restore collectively
 import tempfile
 
+phase("checkpoint")
 ckdir = sys.argv[4] if len(sys.argv) > 4 else tempfile.gettempdir() + "/mh_ck"
 cm.save_checkpoint(ckdir)
 before = float(np.abs(np.asarray(jax.device_get(

@@ -8,9 +8,12 @@
   off dcn axes (config.h:157 control replication is the launch analog; the
   machine model's dcn_axes/dcn_bw are the fabric analog)."""
 
+import os
 import socket
 import subprocess
 import sys
+import threading
+import time
 
 import numpy as np
 import pytest
@@ -26,25 +29,98 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+# a rank that stops heartbeating for this long is hung (coordinator
+# deadlock, wedged collective): kill everything and fail fast instead of
+# eating the whole outer timeout. Phases that legitimately go silent for
+# a while on a loaded machine (XLA compilation, the whole fit, the
+# collective orbax checkpoint) get a larger budget — hang detection
+# there still beats the 420 s communicate timeout, while the handshake
+# phases keep the fast trigger.
+_HEARTBEAT_TIMEOUT = 90.0
+_SLOW_PHASE_TIMEOUT = 240.0
+_SLOW_PHASES = ("compile", "fit", "evaluate", "checkpoint")
+_OVERALL_TIMEOUT = 360.0
+
+
+def _run_workers(procs):
+    """Drain worker stdout on reader threads, tracking liveness via the
+    workers' phase-tagged HB lines; kill the pack when a rank stops
+    making progress or the overall deadline passes. Returns per-process
+    output strings. An HB line only counts as liveness when its PHASE
+    advanced — the heartbeat thread keeps ticking through a hung main
+    thread (wedged collective, coordinator deadlock), so repeated beats
+    in the same phase are exactly the hang signature; the budget for
+    that signature is per-phase (_SLOW_PHASES above)."""
+    outs = [[] for _ in procs]
+    last_beat = [time.monotonic() for _ in procs]
+    cur_phase = [None for _ in procs]
+
+    def reader(i, p):
+        last_phase = None
+        for line in p.stdout:
+            outs[i].append(line)
+            if line.startswith("HB "):
+                ph = line.split(" ph=")[1].split()[0] if " ph=" in line \
+                    else None
+                cur_phase[i] = ph
+                if ph == last_phase:
+                    continue  # same phase: not progress
+                last_phase = ph
+            last_beat[i] = time.monotonic()
+
+    threads = [threading.Thread(target=reader, args=(i, p), daemon=True)
+               for i, p in enumerate(procs)]
+    for t in threads:
+        t.start()
+    deadline = time.monotonic() + _OVERALL_TIMEOUT
+
+    def _budget(i):
+        return _SLOW_PHASE_TIMEOUT if cur_phase[i] in _SLOW_PHASES \
+            else _HEARTBEAT_TIMEOUT
+
+    while any(p.poll() is None for p in procs):
+        now = time.monotonic()
+        stale = [i for i, (p, b) in enumerate(zip(procs, last_beat))
+                 if p.poll() is None and now - b > _budget(i)]
+        if stale or now > deadline:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+            reason = ("worker(s) {} hung: no phase progress for {}s".format(
+                      stale, [_budget(i) for i in stale]) if stale
+                      else f"workers exceeded {_OVERALL_TIMEOUT}s")
+            for t in threads:
+                t.join(timeout=5)
+            raise AssertionError(
+                reason + "\n" + "\n".join(
+                    f"--- worker {i} tail ---\n" + "".join(o[-40:])
+                    for i, o in enumerate(outs)))
+        time.sleep(0.25)
+    for t in threads:
+        t.join(timeout=10)
+    return ["".join(o) for o in outs]
+
+
 def test_two_process_distributed_fit(tmp_path):
     """The mpi_wrapper analog: 2 processes x 4 virtual CPU devices = one
-    8-device world; fit runs control-replicated and converges identically."""
+    8-device world; fit runs control-replicated and converges identically.
+    Workers heartbeat every 2s; a hung rank fails the test fast."""
     port = _free_port()
     nproc = 2
     ckdir = str(tmp_path / "mh_ckpt")
+    env = dict(os.environ)
+    env.pop("FF_FAULT_PLAN", None)  # never inherit an armed fault plan
     procs = [
         subprocess.Popen(
             [sys.executable, "tests/_multihost_worker.py", str(port),
              str(nproc), str(pid), ckdir],
-            cwd="/root/repo", stdout=subprocess.PIPE, stderr=subprocess.PIPE,
-            text=True)
+            cwd="/root/repo", stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True, env=env)
         for pid in range(nproc)
     ]
-    outs = []
-    for p in procs:
-        out, err = p.communicate(timeout=420)
-        assert p.returncode == 0, f"worker failed:\n{out}\n{err[-3000:]}"
-        outs.append(out)
+    outs = _run_workers(procs)
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, f"worker failed:\n{out[-4000:]}"
     results = {}
     for out in outs:
         for line in out.splitlines():

@@ -185,12 +185,29 @@ def test_cross_mesh_checkpoint_restore_of_stage_sharded_state(devices,
     assert h_res[0]["loss"] == pytest.approx(h_ref[0]["loss"], rel=1e-6)
 
 
-def test_stage_count_mismatch_rejected(devices, tmp_path):
+def test_stage_count_elastic_restore_legacy_rejected(devices, tmp_path):
+    """Elastic resume (ISSUE 6) made stage count a placement detail: the
+    per-layer optimizer schema restores a S=2 snapshot onto S=4
+    (trajectory parity covered in tests/test_resilience.py). Only LEGACY
+    stage-keyed checkpoints — no opt_schema marker — are still rejected,
+    cleanly, with a re-save hint."""
+    import json
+
+    from flexflow_tpu.runtime.checkpoint import CheckpointMismatchError
+
     cm1, _ = _train("mlp", 2, epochs=1, n=32)
     ck = str(tmp_path / "ck")
     cm1.save_checkpoint(ck, block=True)
     cm4, _ = _train("mlp", 4, accum=8, epochs=1, n=32)
-    with pytest.raises(ValueError, match="stages"):
+    cm4.load_checkpoint(ck)  # different stage count: elastic re-key
+    assert cm4._iteration == cm1._iteration
+    meta_path = os.path.join(ck, "meta.json")
+    with open(meta_path) as f:
+        meta = json.load(f)
+    del meta["opt_schema"]  # forge a pre-elastic checkpoint
+    with open(meta_path, "w") as f:
+        json.dump(meta, f)
+    with pytest.raises(CheckpointMismatchError, match="legacy"):
         cm4.load_checkpoint(ck)
 
 
