@@ -13,6 +13,19 @@ GSPMD: a MachineView becomes an assignment of tensor dims to mesh axes, and the
 four parallel ops (Repartition/Combine/Replicate/Reduction) become reshardings.
 """
 
+import jax as _jax
+
+# Sharding-invariant RNG. With the legacy (non-partitionable) threefry,
+# jitting a random initializer with SHARDED out_shardings produces
+# DIFFERENT values than the replicated init of the same key — so a
+# hand-sharded strategy (parallel/templates.py) silently trained different
+# weights than its data-parallel twin (the standing hybrid_parallel tier-1
+# failure). The partitionable counter-based generator makes random values a
+# pure function of (key, position), independent of how XLA partitions the
+# computation — the property sharded-at-birth init (compile.py init) and
+# the ZeRO/pipeline cross-mesh restores all assume.
+_jax.config.update("jax_threefry_partitionable", True)
+
 from flexflow_tpu.dtype import DataType
 from flexflow_tpu.config import FFConfig
 from flexflow_tpu.core.tensor import Tensor, TensorSpec

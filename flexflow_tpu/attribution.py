@@ -1,0 +1,369 @@
+"""Per-op performance attribution: where does the step actually go?
+
+Motivation (ISSUE 7): PR 5's drift monitor sees the step as ONE number — it
+can say "the search mispredicted step time by 3x" but not which op the
+analytic cost model misprices, and the BASELINE.md MFU gap (attention
+matmuls ~50% vs MLP 88.8% at head_dim 64) was found by hand. This module is
+the op-level join the next ROADMAP waves stand on: for every (graph layer,
+compiled placement) it lines up
+
+  * the DP's PREDICTED cost (stamped on the strategy at search time —
+    `Strategy._predicted_op_costs` — restored from the strategy cache on
+    warm compiles; analytic fallback for imported/data-parallel
+    strategies),
+  * the MEASURED time — primary path: the Chrome/perfetto trace
+    `jax.profiler` emits under `--profiling`, mapped back to graph layers
+    via the `jax.named_scope(layer.name)` HLO metadata the lowering stamps
+    (compiler/lowering.py); fallback path: a partitioned re-execution that
+    times each layer's jitted fwd/bwd at shard-local shapes on the live
+    machine (search/measure.MeasuredCost — works on CPU CI), rescaled so
+    attributed times sum to the REAL measured step time,
+  * the ROOFLINE bound (search/cost_model.op_roofline): the machine-floor
+    time, which leg (compute vs HBM bandwidth) binds, and the MFU ceiling,
+
+yielding per-op MFU, compute-/bandwidth-bound classification, and a per-op
+drift top-K ("these 3 ops explain 87% of the step-time misprediction").
+This is FlexFlow's calibrated per-op prediction-vs-measurement discipline
+("Beyond Data and Model Parallelism", arXiv 1807.05358) applied at RUN
+time, and every row is featurized exactly the way "A Learned Performance
+Model for TPUs" (arXiv 2008.01040) featurizes ops — (op kind, shapes,
+dtype, layout, sharding, machine) — so a profiled fit with telemetry on
+emits `op/attr` events that tools/span_dataset.py compiles into the
+learned cost model's training corpus (ROADMAP item 2).
+
+Entry points: `CompiledModel.op_attribution()` / `PipelinedModel.
+op_attribution()` (both also feed `profile_report`), `--profile-ops`
+(runs attribution at fit end), and `tools/profile_attribution.py`.
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import hashlib
+import json
+import os
+from typing import Any, Dict, List, Optional, Sequence
+
+from flexflow_tpu import telemetry as tel
+from flexflow_tpu.search import cost_model as cmod
+from flexflow_tpu.search import memo
+
+# telemetry event names (cat "op"): one op/attr per attributed row, one
+# op/drift_topk per report — both consumed by tools/span_dataset.py and
+# surfaced by tools/trace_report.py
+OP_EVENT = "op/attr"
+DRIFT_EVENT = "op/drift_topk"
+
+# acceptance tolerance: attributed per-op times must sum to the measured
+# step time within this fraction (tools/profile_attribution.py --check)
+SUM_TOLERANCE = 0.15
+
+
+# ------------------------------------------------------------ featurization
+def op_features(layer, cand, machine) -> Dict[str, Any]:
+    """The learned-cost-model featurization of one placed op (2008.01040:
+    opcode + shapes + dtype + layout/fusion context, here + sharding +
+    machine fingerprint). Everything JSON-serializable; `feature_key`
+    hashes the identity-relevant subset (the layer NAME is instance
+    identity, not a feature — two gpt2 blocks' identical matmuls must
+    dedup to one corpus row)."""
+    out0 = layer.outputs[0].spec if layer.outputs else None
+    return {
+        "op": layer.op_type.value,
+        "in_shapes": [list(t.spec.shape) for t in layer.inputs],
+        "out_shapes": [list(t.spec.shape) for t in layer.outputs],
+        "weight_shapes": {w: list(s.shape)
+                          for w, s in sorted(layer.weight_specs.items())},
+        "dtype": out0.dtype.value if out0 is not None else "",
+        "params": repr(layer.params_key()),
+        "layout": cand.name,
+        "sharding": {
+            "out": [list(map(_ax_str, d)) for d in cand.out_dims],
+            "weights": {w: list(map(_ax_str, d))
+                        for w, d in sorted(cand.weight_dims.items())},
+        },
+        "machine": memo.machine_fingerprint(machine),
+    }
+
+
+def _ax_str(d) -> str:
+    if d is None:
+        return ""
+    return d if isinstance(d, str) else "+".join(d)
+
+
+def feature_key(features: Dict[str, Any]) -> str:
+    """Stable dedup key of a feature row: sha1 over the canonical JSON of
+    the identity fields. Process-stable (sorted keys, no floats), so
+    corpus rows from different runs/machines merge correctly."""
+    ident = {k: features.get(k) for k in
+             ("op", "in_shapes", "out_shapes", "weight_shapes", "dtype",
+              "params", "layout", "sharding", "machine")}
+    blob = json.dumps(ident, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha1(blob.encode()).hexdigest()[:16]
+
+
+# ----------------------------------------------------- xplane/Chrome trace
+def measured_from_trace(profile_dir: str, layer_names: Sequence[str]
+                        ) -> Optional[Dict[str, float]]:
+    """Primary measurement path: map the profiler's per-kernel timeline
+    back to graph layers. `jax.profiler.trace` (under --profiling) writes
+    `plugins/profile/<run>/*.trace.json[.gz]`; the lowering stamps
+    `jax.named_scope(layer.name)` so XLA op metadata — and therefore the
+    trace event names / `args` — carry "<layer>/..." source names. Returns
+    layer -> total device microseconds across the trace (fused ops whose
+    metadata names several layers credit the FIRST match), or None when no
+    parseable trace exists (the caller falls back to partitioned
+    re-execution). Totals are only meaningful as FRACTIONS of the step —
+    the caller normalizes against the measured step time."""
+    if not profile_dir or not os.path.isdir(profile_dir):
+        return None
+    paths = sorted(
+        glob.glob(os.path.join(profile_dir, "**", "*.trace.json"),
+                  recursive=True)
+        + glob.glob(os.path.join(profile_dir, "**", "*.trace.json.gz"),
+                    recursive=True),
+        key=lambda p: os.path.getmtime(p))
+    if not paths:
+        return None
+    try:
+        opener = gzip.open if paths[-1].endswith(".gz") else open
+        with opener(paths[-1], "rt") as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    events = doc.get("traceEvents", doc if isinstance(doc, list) else [])
+    # boundary-safe matching: a layer is credited only for "<name>/" path
+    # segments (the exact shape named_scope produces in HLO op_name /
+    # source strings) at a segment start — "up" must not absorb "update",
+    # and an event merely MENTIONING a layer mid-word never matches.
+    # Longest-first alternation so "ffn_up_2" wins over a "ffn_up" prefix.
+    import re
+
+    names = sorted(set(layer_names), key=len, reverse=True)
+    if not names:
+        return None
+    pat = re.compile("(?:^|[/ ;,(])("
+                     + "|".join(re.escape(n) for n in names) + ")/")
+    totals: Dict[str, float] = {}
+    for ev in events:
+        if not isinstance(ev, dict) or ev.get("ph") != "X":
+            continue
+        dur = ev.get("dur")
+        if not isinstance(dur, (int, float)) or dur <= 0:
+            continue
+        hay = str(ev.get("name", ""))
+        args = ev.get("args")
+        if isinstance(args, dict):
+            hay += " " + " ".join(str(v) for v in args.values())
+        m = pat.search(hay)
+        if m is not None:
+            totals[m.group(1)] = totals.get(m.group(1), 0.0) + float(dur)
+    return totals or None
+
+
+# ------------------------------------------------------------- the report
+def build_report(items: List[Dict[str, Any]],
+                 step_time_s: Optional[float] = None,
+                 mult: int = 1,
+                 profile_dir: Optional[str] = None,
+                 source: str = "auto",
+                 measure_repeats: int = 3,
+                 measure_warmup: int = 1,
+                 emit: Optional[bool] = None) -> Dict[str, Any]:
+    """Assemble the attribution report.
+
+    items: one dict per placed op — {"layer", "cand", "machine",
+    "predicted_s" (per fwd+bwd pass; None -> analytic), "stage" (or None)}.
+    mult: passes per optimizer update (accum_steps, or the pipeline's M
+    microbatches) — per-op numbers scale by it so every column is per
+    UPDATE, directly comparable to the drift monitor's measured windows.
+    step_time_s: the REAL measured per-update wall time (drift monitor);
+    measured per-op times are rescaled so attributed times sum to it
+    (proportional attribution — the partitioned re-execution measures ops
+    in isolation, so XLA cross-op fusion makes the raw sum overshoot; the
+    trace path's totals are fractions of the stream and need the same
+    normalization). When None, attributed == measured and scale == 1.
+    source: "auto" (trace when available, else measure), "trace",
+    "measure".
+    emit: write op/attr + op/drift_topk telemetry events (default: when
+    the telemetry sink is enabled) — this is what grows the span corpus.
+    """
+    from flexflow_tpu.search.measure import MeasuredCost
+
+    if emit is None:
+        emit = tel.enabled()
+    trace_totals = None
+    if source in ("auto", "trace"):
+        # trace totals are WHOLE-RUN device-time sums (every step of every
+        # epoch) — only their proportions are meaningful, so the trace
+        # path requires a measured step time to normalize against; "auto"
+        # without one falls back to the per-update re-execution path
+        if step_time_s:
+            trace_totals = measured_from_trace(
+                profile_dir or "", [it["layer"].name for it in items])
+        if source == "trace":
+            if not step_time_s:
+                raise ValueError("source='trace' needs a measured step "
+                                 "time (run fit() first)")
+            if trace_totals is None:
+                raise ValueError(f"no parseable profiler trace under "
+                                 f"{profile_dir!r} (run with --profiling)")
+    used_source = "trace" if trace_totals else "measure"
+
+    mcs: Dict[str, MeasuredCost] = {}  # one per machine fingerprint
+
+    def mc_for(machine):
+        fp = memo.machine_fingerprint(machine)
+        if fp not in mcs:
+            mcs[fp] = MeasuredCost(machine, repeats=measure_repeats,
+                                   warmup=measure_warmup, cache_dir="")
+        return mcs[fp]
+
+    rows: List[Dict[str, Any]] = []
+    for it in items:
+        layer, cand, machine = it["layer"], it["cand"], it["machine"]
+        roof = cmod.op_roofline(layer, cand, machine)
+        if trace_totals is not None:
+            # whole-run device us; normalized to per-update seconds below
+            measured = trace_totals.get(layer.name, 0.0) * 1e-6
+        else:
+            measured = mc_for(machine).op_time(layer, cand) * mult
+        predicted = it.get("predicted_s")
+        if predicted is None:
+            predicted = cand.op_time(layer, machine)
+        feats = op_features(layer, cand, machine)
+        rows.append({
+            "stage": it.get("stage"),
+            "layer": layer.name,
+            "op": layer.op_type.value,
+            "candidate": cand.name,
+            "predicted_s": float(predicted) * mult,
+            "measured_s": float(measured),
+            "roofline_s": roof["roofline_s"] * mult,
+            "bound": roof["bound"],
+            "mfu_ceiling": roof["mfu_ceiling"],
+            "flops": roof["flops"],
+            "device_flops": roof["device_flops"] * mult,
+            "hbm_bytes": roof["hbm_bytes"],
+            "machine_flops": machine.flops,
+            "key": feature_key(feats),
+            "features": feats,
+        })
+
+    if used_source == "trace":
+        # per-update measured time = the op's share of the profiled stream
+        # x the real step time (trace totals span every profiled step, so
+        # only the proportions carry over)
+        raw = sum(r["measured_s"] for r in rows)
+        if raw > 0:
+            f = float(step_time_s) / raw
+            for r in rows:
+                r["measured_s"] *= f
+    total_meas = sum(r["measured_s"] for r in rows)
+    scale = 1.0
+    if step_time_s and total_meas > 0:
+        scale = float(step_time_s) / total_meas
+    for r in rows:
+        r["attributed_s"] = r["measured_s"] * scale
+        denom = (r["attributed_s"] if step_time_s else r["measured_s"])
+        r["mfu"] = (r["device_flops"] / (denom * r["machine_flops"])
+                    if denom > 0 else 0.0)
+    rows.sort(key=lambda r: -r["attributed_s"])
+    report = {
+        "rows": rows,
+        "step_time_s": float(step_time_s) if step_time_s else None,
+        "measured_total_s": total_meas,
+        "attributed_total_s": sum(r["attributed_s"] for r in rows),
+        # isolated-measurement over-coverage of the real step (fusion /
+        # overlap the isolated path can't see; trace path: stream fraction)
+        "coverage": (total_meas / step_time_s) if step_time_s else None,
+        "scale": scale,
+        "mult": mult,
+        "source": used_source,
+    }
+    report["top_drift"] = drift_top_k(rows)
+    if emit:
+        for r in rows:
+            args = {k: r[k] for k in
+                    ("layer", "op", "candidate", "predicted_s",
+                     "measured_s", "attributed_s", "roofline_s", "bound",
+                     "mfu", "mfu_ceiling", "key")}
+            if r["stage"] is not None:
+                args["stage"] = r["stage"]
+            args["source"] = used_source
+            args["features"] = r["features"]
+            tel.event(OP_EVENT, cat="op", **args)
+        td = report["top_drift"]
+        if td["rows"]:
+            tel.event(DRIFT_EVENT, cat="op",
+                      worst=td["rows"][0]["layer"],
+                      explained=td["explained"],
+                      rows=[{"layer": x["layer"], "err_s": x["err_s"],
+                             "share": x["share"]} for x in td["rows"]])
+    return report
+
+
+def drift_top_k(rows: Sequence[Dict[str, Any]], k: int = 3
+                ) -> Dict[str, Any]:
+    """The per-op drift localization: which ops explain the step-time
+    misprediction? err = attributed - predicted per op; the top-k by |err|
+    with their share of the total absolute error. `explained` is the
+    cumulative share — "these 3 ops explain 87% of the misprediction" is
+    the cue to recalibrate exactly those measurements (tools/calibrate.py)
+    or reroute the search around the mispriced placement."""
+    errs = []
+    for r in rows:
+        meas = r.get("attributed_s", r.get("measured_s", 0.0))
+        errs.append((abs(meas - r["predicted_s"]),
+                     meas - r["predicted_s"], r))
+    total = sum(a for a, _e, _r in errs)
+    errs.sort(key=lambda x: -x[0])
+    out = []
+    cum = 0.0
+    for a, e, r in errs[:max(0, k)]:
+        share = a / total if total > 0 else 0.0
+        cum += share
+        out.append({"layer": r["layer"], "op": r["op"],
+                    "predicted_s": r["predicted_s"],
+                    "measured_s": r.get("attributed_s",
+                                        r.get("measured_s", 0.0)),
+                    "err_s": e, "share": share})
+    return {"rows": out, "explained": cum,
+            "total_abs_err_s": total, "k": min(k, len(errs))}
+
+
+# ------------------------------------------------------------- rendering
+def format_report(report: Dict[str, Any], top: int = 0) -> List[str]:
+    """The [ops] table + [drift] top-K lines (profile_report and
+    tools/profile_attribution.py share this formatting)."""
+    rows = report["rows"][:top] if top else report["rows"]
+    has_stage = any(r["stage"] is not None for r in rows)
+    lines = []
+    head = ("st " if has_stage else "") + \
+        f"{'layer':24} {'op':14} {'pred':>9} {'attr':>9} {'roof':>9} " \
+        f"{'mfu':>5} {'bound':>9} {'%':>5}"
+    lines.append(head)
+    total = report["attributed_total_s"] or 1.0
+    for r in rows:
+        st = f"{r['stage']:2d} " if has_stage else ""
+        lines.append(
+            f"{st}{r['layer'][:24]:24} {r['op'][:14]:14} "
+            f"{r['predicted_s'] * 1e6:8.1f}u {r['attributed_s'] * 1e6:8.1f}u "
+            f"{r['roofline_s'] * 1e6:8.1f}u {r['mfu']:5.2f} "
+            f"{r['bound']:>9} {100 * r['attributed_s'] / total:4.1f}%")
+    st_ = report.get("step_time_s")
+    lines.append(
+        f"[ops] source={report['source']} "
+        f"attributed_total={report['attributed_total_s'] * 1e3:.3f}ms"
+        + (f" step={st_ * 1e3:.3f}ms coverage={report['coverage']:.2f}x"
+           if st_ else " (no measured step time; run fit() first)"))
+    td = report["top_drift"]
+    if td["rows"]:
+        worst = ", ".join(f"{x['layer']} ({x['err_s'] * 1e6:+.1f}us)"
+                          for x in td["rows"])
+        lines.append(f"[drift] top-{td['k']} mispriced ops explain "
+                     f"{100 * td['explained']:.0f}% of the per-op "
+                     f"misprediction: {worst}")
+    return lines

@@ -796,9 +796,17 @@ class CompiledModel:
                     print(f"[profiling] trace written to "
                           f"{self.cfg.profile_dir or './ff_profile'}")
         self._fit_end_report(verbose)
-        # per-op table only on the success path (it launches measurement
-        # jits; on an error path it would mask the real exception)
-        if prof_ctx is not None and verbose:
+        # per-op work only on the success path (it launches measurement
+        # jits; on an error path it would mask the real exception).
+        # --profile-ops: attribute the fit's REAL measured step time to
+        # individual ops (flexflow_tpu/attribution.py) — only when someone
+        # consumes the result (printed table or the telemetry corpus), and
+        # not when profile_report below runs the same join anyway
+        will_report = prof_ctx is not None and verbose
+        if self.cfg.profile_ops and (verbose or tel.enabled()) \
+                and not will_report:
+            self.op_attribution(print_table=verbose)
+        if will_report:
             self.profile_report()
         return history
 
@@ -1104,36 +1112,13 @@ class CompiledModel:
     # ------------------------------------------------------------ profiling
     def _candidate_for(self, layer):
         """The sharding candidate matching the COMPILED strategy's weight
-        layout for this layer (falls back to dp when nothing matches)."""
-        from flexflow_tpu.search.candidates import layer_candidates
+        layout for this layer (falls back to dp when nothing matches) —
+        see candidates.compiled_candidate."""
+        from flexflow_tpu.search.candidates import compiled_candidate
 
         batch_sizes = {t.shape[0] for t in self.model.input_tensors if t.ndim > 0}
-        cands = layer_candidates(layer, self.machine, batch_sizes)
-        sh = self.strategy.op_shardings.get(layer.name)
-
-        def norm(dims):
-            return [None if d in (None, []) else (d if isinstance(d, str) else tuple(d))
-                    for d in (dims or [])]
-
-        if sh is not None:
-            from flexflow_tpu.search.candidates import candidate_attrs
-
-            want_w = {w: norm(d) for w, d in sh.weights.items()}
-            want_attrs = dict(sh.attrs or {})
-            # attrs disambiguate candidates with identical weight layouts
-            # (a grouped inter: placement keeps weights replicated like dp);
-            # fall back to the first layout-only match in the same scan
-            layout_match = None
-            for c in cands:
-                if c.passthrough or \
-                        {w: norm(d) for w, d in c.weight_dims.items()} != want_w:
-                    continue
-                if candidate_attrs(c) == want_attrs:
-                    return c
-                layout_match = layout_match or c
-            if layout_match is not None:
-                return layout_match
-        return cands[0]
+        return compiled_candidate(layer, self.strategy, self.machine,
+                                  batch_sizes)
 
     def memory_stats(self) -> dict:
         """Per-device persistent-memory report: what the search-side cost
@@ -1232,6 +1217,44 @@ class CompiledModel:
         return tel.drift_stats(self.predicted_step_time(),
                                list(self._drift_windows))
 
+    def op_attribution(self, step_time_s: Optional[float] = None,
+                       source: str = "auto", top: int = 0,
+                       print_table: bool = True) -> dict:
+        """Per-op performance attribution (ISSUE 7 tentpole; see
+        flexflow_tpu/attribution.py): joins each compiled op's measured
+        time — the --profiling trace when one exists, else the partitioned
+        re-execution — against the search's stamped per-op predicted cost
+        and the roofline bound. step_time_s defaults to the drift
+        monitor's measured per-update time from the LAST fit (attributed
+        times are rescaled to sum to it); with no fit yet, attributed ==
+        isolated measured. Emits op/attr + op/drift_topk telemetry events
+        when the sink is on (the span-dataset corpus). Returns the report
+        dict ({"rows", "top_drift", "coverage", ...})."""
+        from flexflow_tpu import attribution
+
+        if step_time_s is None:
+            step_time_s = self.drift_stats().get("measured_step_time_s")
+        pred = getattr(self.strategy, "_predicted_op_costs", None) or {}
+        items = []
+        for layer in topo_order(self.model.layers):
+            cand = self._candidate_for(layer)
+            if cand.passthrough:
+                continue
+            items.append({"layer": layer, "cand": cand,
+                          "machine": self.machine,
+                          "predicted_s": pred.get(layer.name),
+                          "stage": None})
+        profile_dir = (self.cfg.profile_dir or "./ff_profile") \
+            if self.cfg.profiling else None
+        report = attribution.build_report(
+            items, step_time_s=step_time_s,
+            mult=max(1, int(self._accum_steps)),
+            profile_dir=profile_dir, source=source)
+        if print_table:
+            for line in attribution.format_report(report, top=top):
+                print(line)
+        return report
+
     def profile_report(self, top: int = 0, print_table: bool = True):
         """Per-op timing table (reference: per-kernel ms prints behind
         --profiling, src/ops/kernels/linear_kernels.cu:98-117): each layer's
@@ -1295,6 +1318,13 @@ class CompiledModel:
                   f"{mem['actual_opt_state_bytes_per_device'] / mb:.2f}MB")
             for line in tel.format_drift(self.drift_stats()):
                 print(line)
+            if self.cfg.profile_ops:
+                # --profile-ops: the full attribution join (measured vs
+                # predicted vs roofline, MFU, per-op drift top-K)
+                self.op_attribution(print_table=True, top=top)
+            else:
+                print("[drift] per-op attribution: --profile-ops / "
+                      "op_attribution() / tools/profile_attribution.py")
             from flexflow_tpu.runtime.checkpoint import \
                 report_failed_writes
 

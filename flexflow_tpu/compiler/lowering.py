@@ -107,6 +107,12 @@ def build_forward(
         for layer in order:
             ins = [env[t.guid] for t in layer.inputs]
             w = params.get(layer.name, {})
+            # stamp the graph-layer name into the XLA op metadata
+            # (name_stack -> HLO metadata.op_name): profiler traces emitted
+            # under --profiling carry "<layer.name>/..." source names, which
+            # is how attribution.measured_from_trace maps fused XLA ops back
+            # to graph layers (ISSUE 7 primary measurement path)
+            scope = jax.named_scope(layer.name)
             if cast_to is not None:
                 # uniform mixed-precision policy: master weights stay f32 in
                 # params/optimizer, every op computes in compute_dtype; grads
@@ -119,11 +125,12 @@ def build_forward(
                          if k not in ex and jnp.issubdtype(v.dtype, jnp.floating)
                          else v)
                      for k, v in w.items()}
-            outs = get_op_def(layer.op_type).lower(layer, ins, w, ctx)
-            if mesh is not None:
-                sh = strategy.sharding_for(layer.name)
-                outs = [maybe_constrain(o, sh.output_pspec(i), mesh)
-                        for i, o in enumerate(outs)]
+            with scope:
+                outs = get_op_def(layer.op_type).lower(layer, ins, w, ctx)
+                if mesh is not None:
+                    sh = strategy.sharding_for(layer.name)
+                    outs = [maybe_constrain(o, sh.output_pspec(i), mesh)
+                            for i, o in enumerate(outs)]
             for t, o in zip(layer.outputs, outs):
                 env[t.guid] = o
         result = [env[t.guid] for t in outputs]

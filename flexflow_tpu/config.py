@@ -138,6 +138,14 @@ class FFConfig:
     enable_fusion: bool = True
     profiling: bool = False
     profile_dir: str = ""  # xplane trace output dir ("" = ./ff_profile)
+    # per-op attribution (flexflow_tpu/attribution.py): at fit end, join
+    # per-op measured times (profiler trace under --profiling, else
+    # partitioned re-execution) against the search's stamped per-op
+    # predicted costs and the roofline bound — per-op MFU, compute-vs-
+    # bandwidth classification and the per-op drift top-K, printed via
+    # profile_report and emitted as op/attr telemetry events (the learned
+    # cost model's training corpus, tools/span_dataset.py)
+    profile_ops: bool = False
     allow_tensor_op_math_conversion: bool = True  # = bf16 matmul policy
     compute_dtype: str = "float32"  # params dtype; "bfloat16" enables mixed policy
     remat: bool = False  # jax.checkpoint the forward for memory
@@ -233,6 +241,7 @@ class FFConfig:
         p.add_argument("--no-fusion", dest="fusion", action="store_false")
         p.add_argument("--profiling", action="store_true")
         p.add_argument("--profile-dir", type=str, default="")
+        p.add_argument("--profile-ops", action="store_true")
         p.add_argument("--telemetry-dir", type=str, default="")
         p.add_argument("--compute-dtype", type=str, default="float32")
         p.add_argument("--remat", action="store_true")
@@ -325,6 +334,7 @@ class FFConfig:
             enable_fusion=args.fusion,
             profiling=args.profiling,
             profile_dir=args.profile_dir,
+            profile_ops=args.profile_ops,
             telemetry_dir=args.telemetry_dir,
             compute_dtype=args.compute_dtype,
             remat=args.remat,

@@ -803,6 +803,12 @@ class PipelinedModel:
             if res is not None:
                 res.guard.uninstall()
         self._fit_end_report(verbose)
+        if self.cfg.profile_ops and (verbose or tel.enabled()):
+            # --profile-ops, pipeline edition: per-stage per-op attribution
+            # of the measured update time (flexflow_tpu/attribution.py);
+            # skipped when neither the printed table nor the telemetry
+            # corpus would consume the measurement work
+            self.op_attribution(print_table=verbose)
         return history
 
     def _fit_end_report(self, verbose: bool) -> None:
@@ -968,6 +974,48 @@ class PipelinedModel:
         return tel.drift_stats(self.predicted_step_time(),
                                list(self._drift_windows))
 
+    def op_attribution(self, step_time_s: Optional[float] = None,
+                       source: str = "auto", top: int = 0,
+                       print_table: bool = True) -> dict:
+        """Per-op attribution, pipeline edition (see CompiledModel.
+        op_attribution / flexflow_tpu/attribution.py): every stage's ops on
+        the STAGE machine, each row tagged with its stage, measured/
+        predicted/roofline all per UPDATE (x M microbatches). The update's
+        measured wall time (drift monitor) is the makespan of CONCURRENT
+        stages, so attributed times — rescaled to sum to it — express each
+        op's share of the wall clock, not of the summed stage-local work
+        (`coverage` reports that ratio)."""
+        from flexflow_tpu import attribution
+        from flexflow_tpu.search.candidates import compiled_candidate
+
+        if step_time_s is None:
+            step_time_s = self.drift_stats().get("measured_step_time_s")
+        pred = getattr(self.strategy, "_predicted_op_costs", None) or {}
+        bs = self._batch_sizes()
+        items = []
+        for s, seg in enumerate(self.stage_layers):
+            for layer in seg:
+                # the COMPILED intra-stage placement, not the dp default —
+                # corpus rows must describe what actually ran
+                cand = compiled_candidate(layer, self.strategy,
+                                          self.stage_machine, bs)
+                if cand.passthrough:
+                    continue
+                items.append({"layer": layer, "cand": cand,
+                              "machine": self.stage_machine,
+                              "predicted_s": pred.get(layer.name),
+                              "stage": s})
+        profile_dir = (self.cfg.profile_dir or "./ff_profile") \
+            if self.cfg.profiling else None
+        report = attribution.build_report(
+            items, step_time_s=step_time_s,
+            mult=max(1, int(self.cfg.accum_steps)),
+            profile_dir=profile_dir, source=source)
+        if print_table:
+            for line in attribution.format_report(report, top=top):
+                print(line)
+        return report
+
     def profile_report(self, top: int = 0, print_table: bool = True):
         """Per-op timing table, pipeline edition: each stage's layers under
         the dp candidate on the STAGE machine (analytic + isolated
@@ -1014,6 +1062,11 @@ class PipelinedModel:
                      else "measured_bubble=n/a (enable --telemetry-dir)"))
             for line in tel.format_drift(self.drift_stats()):
                 print(line)
+            if self.cfg.profile_ops:
+                self.op_attribution(print_table=True, top=top)
+            else:
+                print("[drift] per-op attribution: --profile-ops / "
+                      "op_attribution() / tools/profile_attribution.py")
             mem = self.memory_stats()
             mbyte = 1024 * 1024
             for s in range(self.num_stages):

@@ -83,19 +83,24 @@ class Candidate:
             return memo.put("op_time", key, self._op_time(layer, machine))
         return self._op_time(layer, machine)
 
-    def _op_time(self, layer: "Layer", machine: MachineSpec) -> float:
+    def flops_bytes(self, layer: "Layer", machine: MachineSpec):
+        """(total fwd flops, per-device HBM bytes, effective degree) of this
+        placement — the roofline inputs shared by _op_time and the per-op
+        attribution layer (flexflow_tpu/attribution.py): activations divide
+        by the compute degree, weights stream per replica (each device reads
+        its own shard, scaled by weight_stream_frac)."""
         od = get_op_def(layer.op_type)
-        # per-device HBM traffic: activations divide by the compute degree,
-        # weights stream in full per replica (each device reads its own shard)
         act_bytes = (sum(i.spec.size_bytes for i in layer.inputs)
                      + sum(o.spec.size_bytes for o in layer.outputs))
         w_bytes = self.weight_stream_frac * sum(
             cm.shard_bytes(s, self.weight_dims.get(w, []), machine)
             for w, s in layer.weight_specs.items())
         deg = max(1.0, self.compute_degree * self.eff)
-        hbm = act_bytes / deg + w_bytes
-        t = cm.compute_time(od.flop_count(layer), hbm, machine, deg,
-                            bytes_predivided=True)
+        return od.flop_count(layer), act_bytes / deg + w_bytes, deg
+
+    def _op_time(self, layer: "Layer", machine: MachineSpec) -> float:
+        flops, hbm, deg = self.flops_bytes(layer, machine)
+        t = cm.compute_time(flops, hbm, machine, deg, bytes_predivided=True)
         t += self.extra_comm
         t += cm.grad_sync_time(layer.weight_specs, self.weight_dims, machine,
                                _batch_axes(machine))
@@ -124,6 +129,40 @@ class Candidate:
             m += 2 * sb + moment_bytes // cm.zero_divisor(
                 spec, dims, machine, opt_mem.zero_axes)
         return m
+
+
+def compiled_candidate(layer: "Layer", strategy, machine: MachineSpec,
+                       batch_sizes) -> "Candidate":
+    """The sharding candidate matching the COMPILED strategy's weight
+    layout + attrs for this layer (falls back to dp when nothing matches).
+    Shared by CompiledModel._candidate_for and the pipeline edition of
+    op_attribution — attribution rows must describe the placement that
+    actually compiled, or the span corpus trains on mislabeled features."""
+    cands = layer_candidates(layer, machine, batch_sizes)
+    sh = strategy.op_shardings.get(layer.name)
+
+    def norm(dims):
+        return [None if d in (None, []) else
+                (d if isinstance(d, str) else tuple(d))
+                for d in (dims or [])]
+
+    if sh is not None:
+        want_w = {w: norm(d) for w, d in sh.weights.items()}
+        want_attrs = dict(sh.attrs or {})
+        # attrs disambiguate candidates with identical weight layouts
+        # (a grouped inter: placement keeps weights replicated like dp);
+        # fall back to the first layout-only match in the same scan
+        layout_match = None
+        for c in cands:
+            if c.passthrough or \
+                    {w: norm(d) for w, d in c.weight_dims.items()} != want_w:
+                continue
+            if candidate_attrs(c) == want_attrs:
+                return c
+            layout_match = layout_match or c
+        if layout_match is not None:
+            return layout_match
+    return cands[0]
 
 
 def candidate_attrs(cand: "Candidate") -> Dict[str, str]:

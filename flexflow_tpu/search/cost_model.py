@@ -162,17 +162,59 @@ def all_to_all_time(shard_bytes_: float, axes, machine: MachineSpec) -> float:
     return (k - 1) / k * shard_bytes_ / bw
 
 
-def compute_time(flops: float, hbm_bytes: float, machine: MachineSpec,
-                 degree: float = 1, bytes_predivided: bool = False) -> float:
-    """Roofline on one chip for 1/degree of the work; fwd+bwd ≈ 3x fwd flops
+def roofline_split(flops: float, hbm_bytes: float, machine: MachineSpec,
+                   degree: float = 1, bytes_predivided: bool = False
+                   ) -> Tuple[float, float]:
+    """The two legs of the per-chip roofline for 1/degree of one training
+    step's work over an op: (t_flop, t_mem). fwd+bwd ≈ 3x fwd flops
     (reference simulator models fwd and bwd tasks separately; the 3x is the
-    standard dense-training ratio). When bytes_predivided, hbm_bytes is
-    already the per-device traffic."""
+    standard dense-training ratio); HBM traffic ≈ 2x the forward bytes.
+    When bytes_predivided, hbm_bytes is already the per-device traffic.
+    compute_time takes the max; the attribution layer
+    (flexflow_tpu/attribution.py) reads both legs to classify each op as
+    compute-bound vs bandwidth-bound and derive its MFU ceiling."""
     d = max(1.0, degree)
     eff_flops = machine.flops / machine.mxu_flop_overhead
     t_flop = 3.0 * flops / d / eff_flops
     t_mem = 2.0 * hbm_bytes / (1.0 if bytes_predivided else d) / machine.hbm_bw
+    return t_flop, t_mem
+
+
+def compute_time(flops: float, hbm_bytes: float, machine: MachineSpec,
+                 degree: float = 1, bytes_predivided: bool = False) -> float:
+    """Roofline on one chip: max of the compute and memory legs (see
+    roofline_split)."""
+    t_flop, t_mem = roofline_split(flops, hbm_bytes, machine, degree,
+                                   bytes_predivided)
     return max(t_flop, t_mem)
+
+
+def op_roofline(layer, cand, machine: MachineSpec) -> Dict[str, float]:
+    """Per-op roofline facts for one (layer, candidate placement): the
+    machine-bound minimum time for this op's fwd+bwd work, which leg binds,
+    and the MFU ceiling the roofline permits. This is the query ISSUE 7's
+    attribution joins against measured per-op times — `mfu_ceiling` is what
+    a perfectly-scheduled kernel could reach (1.0 when compute-bound at
+    peak, < 1 when HBM bandwidth caps it), so measured_mfu / mfu_ceiling
+    isolates scheduling loss from roofline loss."""
+    flops, hbm_bytes, degree = cand.flops_bytes(layer, machine)
+    t_flop, t_mem = roofline_split(flops, hbm_bytes, machine, degree,
+                                   bytes_predivided=True)
+    t = max(t_flop, t_mem)
+    # flops/s the roofline bound sustains, over the chip's PEAK (not the
+    # overhead-derated rate the bound itself uses)
+    dev_flops = 3.0 * flops / max(1.0, degree)
+    return {
+        "flops": flops,
+        "device_flops": dev_flops,
+        "hbm_bytes": hbm_bytes,
+        "degree": degree,
+        "roofline_s": t,
+        "t_flop_s": t_flop,
+        "t_mem_s": t_mem,
+        "bound": "bandwidth" if t_mem > t_flop else "compute",
+        "mfu_ceiling": (dev_flops / (t * machine.flops)) if t > 0 else 0.0,
+    }
 
 
 def overlapped_step_cost(comp: float, comm: float, machine: MachineSpec) -> float:

@@ -91,8 +91,11 @@ def graph_optimize(model, machine: MachineSpec,
                                    opt_mem=opt_mem)
     # stamp the search's own per-step prediction: the drift monitor
     # compares THIS number (what the search believed when it chose the
-    # strategy) against what fit actually measures
+    # strategy) against what fit actually measures — and the PER-OP costs,
+    # so the attribution layer (flexflow_tpu/attribution.py) can localize
+    # a mispredicted step to the ops the DP misprices
     st._predicted_cost = stats.best_cost
+    st._predicted_op_costs = dict(stats.op_costs)
     tel.event("search/result", cat="compile", cost_s=stats.best_cost,
               baseline_cost_s=stats.baseline_cost,
               expansions=stats.expansions)
@@ -106,6 +109,7 @@ def graph_optimize(model, machine: MachineSpec,
             key = sc.cache_key(model, machine, cfg, calib, opt_fp)
         sc.store(cache_dir, key, st, meta={
             "cost_s": stats.best_cost,
+            "op_costs_s": dict(stats.op_costs),
             "baseline_cost_s": stats.baseline_cost,
             "expansions": stats.expansions,
             "search_wallclock_s": time.perf_counter() - t0,

@@ -236,6 +236,12 @@ def lookup(cache_dir: str, key: str, model,
     cost = entry.get("meta", {}).get("cost_s")
     if cost:
         st._predicted_cost = float(cost)
+    # ... and the per-op breakdown, so warm compiles keep the per-op drift
+    # attribution (flexflow_tpu/attribution.py) the cold search enabled
+    op_costs = entry.get("meta", {}).get("op_costs_s")
+    if isinstance(op_costs, dict):
+        st._predicted_op_costs = {str(k): float(v)
+                                  for k, v in op_costs.items()}
     return st
 
 

@@ -56,6 +56,11 @@ class UnityStats:
     # — replayable onto a structurally identical graph (segment memoization)
     best_path: Tuple = ()
     segments_replayed: int = 0
+    # the DP's PER-OP cost under the winning strategy, model layer name ->
+    # seconds — what the search believed each op costs. Stamped on the
+    # Strategy (graph_optimize) so the per-op attribution layer
+    # (flexflow_tpu/attribution.py) can localize drift to individual ops
+    op_costs: Dict[str, float] = dataclasses.field(default_factory=dict)
 
     @property
     def improvement(self) -> float:
@@ -455,6 +460,20 @@ def unity_optimize(model, machine: MachineSpec, cost_fn=None,
                             for l in topo_order(best.layers)])
         strategy_from_pcg(best, machine, best_r, model_layer_names,
                           model_input_names, strategy=st)
+        # per-op predicted costs of the winner, priced by the SAME cost
+        # function the DP ranked with (measured when cost_fn is set)
+        for layer in topo_order(best.layers):
+            if layer.name not in model_layer_names:
+                continue
+            cand = best_r.choices.get(layer.name)
+            if cand is None or cand.passthrough:
+                continue
+            try:
+                stats_all.op_costs[layer.name] = float(
+                    cost_fn(layer, cand) if cost_fn
+                    else cand.op_time(layer, machine))
+            except Exception:
+                continue
     st.name = (f"unity(cost={stats_all.best_cost * 1e3:.3f}ms, "
                f"x{stats_all.improvement:.2f} vs dp, "
                f"{stats_all.expansions} expansions, "

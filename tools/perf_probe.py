@@ -107,7 +107,32 @@ def probe(iters: int = 20, windows: int = 3):
     out["optimizer_delta_ms"] = out["adam_step_ms"] - out["sgd_step_ms"]
     out["ce_delta_ms"] = out["adam_step_ms"] - out["identity_loss_step_ms"]
     out["bwd_update_ms"] = out["adam_step_ms"] - out["fwd_only_ms"]
+    _emit_telemetry(out, iters=iters, windows=windows)
     return out
+
+
+def _emit_telemetry(out, **meta):
+    """Land the probe's measurements in the unified span stream when a sink
+    is active (--telemetry-dir here, or a prior telemetry.configure in the
+    process): one `probe/<variant>` span per measurement, dur = the
+    measured per-step time, so probe runs join the same corpus
+    trace_report/span_dataset read instead of living on stdout only
+    (ISSUE 7 satellite)."""
+    from flexflow_tpu import telemetry as tel
+
+    if not tel.enabled():
+        return
+    now = tel.now_us()
+    for k, v in out.items():
+        # deltas are derived, not measurements — record the timed variants
+        if not k.endswith("_ms") or k.endswith("_delta_ms") \
+                or k == "bwd_update_ms":
+            continue
+        tel.record(f"probe/{k[:-3]}", now - v * 1e3, now, cat="probe",
+                   step_ms=float(v), **meta)
+    tel.event("probe/summary", cat="probe",
+              **{k: float(v) for k, v in out.items()}, **meta)
+    tel.flush()
 
 
 if __name__ == "__main__":
@@ -117,6 +142,13 @@ if __name__ == "__main__":
     ap = argparse.ArgumentParser()
     ap.add_argument("--iters", type=int, default=20)
     ap.add_argument("--windows", type=int, default=3)
+    ap.add_argument("--telemetry-dir", default="",
+                    help="also emit probe/<variant> spans into this "
+                         "telemetry dir (unified span stream)")
     args = ap.parse_args()
+    if args.telemetry_dir:
+        from flexflow_tpu import telemetry
+
+        telemetry.configure(args.telemetry_dir)
     for k, v in probe(args.iters, args.windows).items():
         print(f"{k:26s} {v:9.2f}")

@@ -1,0 +1,264 @@
+#!/usr/bin/env python
+"""Compile a telemetry dir into the learned cost model's training corpus.
+
+The telemetry→dataset pipeline (ISSUE 7): every profiled fit (`--profile-ops`
+with `--telemetry-dir`) emits one `op/attr` event per placed op, featurized
+the way "A Learned Performance Model for TPUs" (arXiv 2008.01040) featurizes
+ops — (op kind, shapes, dtype, layout, sharding, machine fingerprint) plus
+the measured/predicted/roofline times. This tool folds a telemetry dir (or
+one .jsonl file) into a DEDUPLICATED JSON-Lines corpus: one row per feature
+key (flexflow_tpu/attribution.feature_key — identical ops across runs,
+layers and processes merge), carrying measured-time statistics. This corpus
+is exactly the training input ROADMAP item 2's learned performance model
+needs; re-running over a growing telemetry dir is idempotent-by-key, so
+every profiled fit grows the dataset.
+
+Usage:
+    python tools/span_dataset.py <telemetry-dir-or-file> [--out corpus.jsonl]
+                                 [--merge existing.jsonl]
+    python tools/span_dataset.py --check   # CI smoke: profiled fit -> corpus
+
+Row schema (one JSON object per line):
+  {"key": str, "features": {...2008.01040 featurization...},
+   "machine": str, "n": int, "measured_s": {"mean", "p50", "min", "max"},
+   "attributed_s_mean": float, "predicted_s": float, "roofline_s": float,
+   "mfu_mean": float, "bound": str, "sources": [..]}
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+from typing import Any, Dict, List, Optional
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def collect_rows(path: str) -> List[Dict[str, Any]]:
+    """op/attr events from a telemetry stream, grouped by feature key."""
+    from flexflow_tpu.attribution import OP_EVENT, feature_key
+    from flexflow_tpu.telemetry import read_events
+
+    groups: Dict[str, Dict[str, Any]] = {}
+    for ev in read_events(path):
+        if ev.get("name") != OP_EVENT:
+            continue
+        args = ev.get("args") or {}
+        feats = args.get("features")
+        if not isinstance(feats, dict):
+            continue
+        key = args.get("key") or feature_key(feats)
+        g = groups.setdefault(key, {
+            "key": key, "features": feats,
+            "machine": feats.get("machine", ""),
+            "measured": [], "attributed": [], "mfu": [],
+            "predicted_s": None, "roofline_s": None, "bound": None,
+            "sources": set(),
+        })
+        if args.get("measured_s") is not None:
+            g["measured"].append(float(args["measured_s"]))
+        if args.get("attributed_s") is not None:
+            g["attributed"].append(float(args["attributed_s"]))
+        if args.get("mfu") is not None:
+            g["mfu"].append(float(args["mfu"]))
+        # predicted/roofline are deterministic per feature key — last wins
+        if args.get("predicted_s") is not None:
+            g["predicted_s"] = float(args["predicted_s"])
+        if args.get("roofline_s") is not None:
+            g["roofline_s"] = float(args["roofline_s"])
+        if args.get("bound"):
+            g["bound"] = args["bound"]
+        if args.get("source"):
+            g["sources"].add(str(args["source"]))
+    rows = []
+    for key in sorted(groups):
+        g = groups[key]
+        ms = sorted(g["measured"])
+        rows.append({
+            "key": key,
+            "features": g["features"],
+            "machine": g["machine"],
+            "n": len(ms),
+            "measured_s": {
+                "mean": sum(ms) / len(ms) if ms else None,
+                "p50": statistics.median(ms) if ms else None,
+                "min": ms[0] if ms else None,
+                "max": ms[-1] if ms else None,
+            },
+            "attributed_s_mean": (sum(g["attributed"]) / len(g["attributed"])
+                                  if g["attributed"] else None),
+            "mfu_mean": (sum(g["mfu"]) / len(g["mfu"]) if g["mfu"]
+                         else None),
+            "predicted_s": g["predicted_s"],
+            "roofline_s": g["roofline_s"],
+            "bound": g["bound"],
+            "sources": sorted(g["sources"]),
+        })
+    return rows
+
+
+def merge_rows(base: List[Dict[str, Any]], new: List[Dict[str, Any]]
+               ) -> List[Dict[str, Any]]:
+    """Fold freshly collected rows into an existing corpus: same key ->
+    measurement counts/statistics pool (weighted mean, conservative
+    min/max; p50 takes the larger sample's), new keys append."""
+    by_key = {r["key"]: dict(r) for r in base}
+    for r in new:
+        old = by_key.get(r["key"])
+        if old is None:
+            by_key[r["key"]] = r
+            continue
+        n0, n1 = int(old.get("n") or 0), int(r.get("n") or 0)
+        m0, m1 = old.get("measured_s") or {}, r.get("measured_s") or {}
+        if n0 + n1 > 0 and (m0.get("mean") is not None
+                            or m1.get("mean") is not None):
+            mean0 = m0.get("mean") or 0.0
+            mean1 = m1.get("mean") or 0.0
+            merged = {
+                "mean": (mean0 * n0 + mean1 * n1) / max(1, n0 + n1),
+                "p50": (m0 if n0 >= n1 else m1).get("p50"),
+                "min": min(x for x in (m0.get("min"), m1.get("min"))
+                           if x is not None),
+                "max": max(x for x in (m0.get("max"), m1.get("max"))
+                           if x is not None),
+            }
+            old["measured_s"] = merged
+        old["n"] = n0 + n1
+        for k in ("predicted_s", "roofline_s", "bound", "attributed_s_mean",
+                  "mfu_mean"):
+            if r.get(k) is not None:
+                old[k] = r[k]
+        old["sources"] = sorted(set(old.get("sources") or [])
+                                | set(r.get("sources") or []))
+        by_key[r["key"]] = old
+    return [by_key[k] for k in sorted(by_key)]
+
+
+def write_jsonl(rows: List[Dict[str, Any]], out_path: str) -> None:
+    tmp = out_path + f".tmp{os.getpid()}"
+    with open(tmp, "w") as f:
+        for r in rows:
+            f.write(json.dumps(r, sort_keys=True, separators=(",", ":"))
+                    + "\n")
+    os.replace(tmp, out_path)
+
+
+def read_jsonl(path: str) -> List[Dict[str, Any]]:
+    rows = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    r = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(r, dict) and r.get("key"):
+                    rows.append(r)
+    except OSError:
+        pass
+    return rows
+
+
+def build(path: str, out_path: Optional[str] = None,
+          merge: Optional[str] = None, quiet: bool = False
+          ) -> List[Dict[str, Any]]:
+    rows = collect_rows(path)
+    if merge:
+        rows = merge_rows(read_jsonl(merge), rows)
+    if out_path:
+        write_jsonl(rows, out_path)
+    if not quiet:
+        n_meas = sum(r["n"] for r in rows)
+        print(f"{len(rows)} corpus rows ({n_meas} measurements) from {path}"
+              + (f" -> {out_path}" if out_path else ""))
+    return rows
+
+
+# --------------------------------------------------------------- check mode
+def _check() -> int:
+    """CI smoke: profiled tiny fit -> non-empty featurized corpus whose
+    rows ROUND-TRIP with stable feature keys (write -> read -> recompute
+    feature_key(features) == key), and whose merge is idempotent-by-key."""
+    import tempfile
+
+    import numpy as np
+
+    from flexflow_tpu import FFConfig, FFModel, SGDOptimizer, telemetry
+    from flexflow_tpu.attribution import feature_key
+
+    with tempfile.TemporaryDirectory() as td:
+        tdir = os.path.join(td, "telemetry")
+        cfg = FFConfig(batch_size=16, only_data_parallel=True,
+                       telemetry_dir=tdir, profile_ops=True,
+                       log_level="warning")
+        m = FFModel(cfg)
+        x = m.create_tensor([16, 8], name="x")
+        m.dense(m.dense(x, 16, activation="relu", name="fc1"), 4,
+                name="fc2")
+        cm = m.compile(SGDOptimizer(lr=0.01),
+                       loss_type="sparse_categorical_crossentropy",
+                       metrics=[])
+        cm.init(seed=0)
+        rng = np.random.default_rng(0)
+        xv = rng.normal(size=(64, 8)).astype(np.float32)
+        yv = rng.integers(0, 4, size=(64,)).astype(np.int32)
+        cm.fit(xv, yv, epochs=2, verbose=False)
+        telemetry.flush()
+        out = os.path.join(td, "corpus.jsonl")
+        rows = build(tdir, out_path=out, quiet=True)
+        telemetry.shutdown()
+
+        assert rows, "profiled fit produced an empty corpus"
+        assert all(r["n"] >= 1 and r["measured_s"]["mean"] is not None
+                   for r in rows), rows
+        back = read_jsonl(out)
+        assert len(back) == len(rows), (len(back), len(rows))
+        for r in back:
+            assert feature_key(r["features"]) == r["key"], \
+                f"unstable feature key for {r['features'].get('op')}"
+            assert r.get("predicted_s") is not None
+            assert r.get("roofline_s") is not None
+        # idempotent-by-key: folding the same telemetry in again must not
+        # create new rows (counts grow, keys don't)
+        merged = build(tdir, out_path=None, merge=out, quiet=True)
+        assert len(merged) == len(rows), (len(merged), len(rows))
+        assert all(mr["n"] == 2 * r["n"] for mr, r in
+                   zip(merged, sorted(rows, key=lambda x: x["key"])))
+    print("span_dataset --check OK")
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        "span_dataset", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("path", nargs="?", default=None,
+                    help="telemetry dir or one telemetry-*.jsonl file")
+    ap.add_argument("--out", default=None,
+                    help="corpus JSONL path (default <dir>/op_corpus.jsonl)")
+    ap.add_argument("--merge", default=None,
+                    help="existing corpus to fold the new rows into")
+    ap.add_argument("--check", action="store_true",
+                    help="CI smoke: profiled fit -> corpus -> validate")
+    args = ap.parse_args(argv)
+    if args.check:
+        return _check()
+    if not args.path:
+        ap.error("path required (or --check)")
+    out = args.out
+    if out is None:
+        base = args.path if os.path.isdir(args.path) \
+            else os.path.dirname(args.path) or "."
+        out = os.path.join(base, "op_corpus.jsonl")
+    build(args.path, out_path=out, merge=args.merge)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -149,6 +149,27 @@ def drift_events(events: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
             if ev.get("name") == "fit/drift"]
 
 
+def op_attr_rows(events: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """Per-op attribution rows (op/attr events from --profile-ops runs,
+    flexflow_tpu/attribution.py), newest occurrence per (layer, stage) —
+    the [ops] section and the raw material of tools/span_dataset.py."""
+    by_op: Dict[Any, Dict[str, Any]] = {}
+    for ev in events:
+        if ev.get("name") != "op/attr":
+            continue
+        args = ev.get("args") or {}
+        if args.get("layer"):
+            by_op[(args.get("layer"), args.get("stage"))] = args
+    rows = list(by_op.values())
+    rows.sort(key=lambda r: -(r.get("attributed_s") or 0.0))
+    return rows
+
+
+def op_drift_events(events: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    return [ev.get("args", {}) for ev in events
+            if ev.get("name") == "op/drift_topk"]
+
+
 def error_events(events: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
     return [ev for ev in events if ev.get("cat") == "error"]
 
@@ -166,6 +187,8 @@ def render(path: str, out_path: Optional[str] = None, top: int = 0,
     bubble = pipeline_bubble(events)
     drifts = drift_events(events)
     errors = error_events(events)
+    ops = op_attr_rows(events)
+    op_drifts = op_drift_events(events)
     if not quiet:
         print(f"{len(events)} events from {path}")
         print_summary(rows, top=top)
@@ -184,10 +207,26 @@ def render(path: str, out_path: Optional[str] = None, top: int = 0,
                       f"measured_step={meas * 1e3:.3f}ms "
                       f"ratio={meas / pred:.2f}x"
                       + (" DRIFT-WARNING" if d.get("warn") else ""))
+        if ops:
+            show = ops[:top] if top else ops[:12]
+            print(f"[ops] {len(ops)} attributed ops "
+                  "(attributed / predicted / roofline, per update):")
+            for r in show:
+                st = f" s{r['stage']}" if r.get("stage") is not None else ""
+                print(f"[ops]   {str(r.get('layer'))[:28]:28}{st} "
+                      f"{(r.get('attributed_s') or 0) * 1e6:9.1f}u / "
+                      f"{(r.get('predicted_s') or 0) * 1e6:9.1f}u / "
+                      f"{(r.get('roofline_s') or 0) * 1e6:9.1f}u  "
+                      f"mfu={r.get('mfu', 0):.2f} {r.get('bound', '?')}")
+        for d in op_drifts:
+            print(f"[ops] drift top-K: worst={d.get('worst')} "
+                  f"explains(top-k)={100 * (d.get('explained') or 0):.0f}% "
+                  "of the per-op misprediction")
         for ev in errors:
             print(f"[error] {ev['name']}: {ev.get('args', {})}")
     return {"events": events, "summary": rows, "chrome": chrome,
-            "bubble": bubble, "drift": drifts, "errors": errors}
+            "bubble": bubble, "drift": drifts, "errors": errors,
+            "ops": ops, "op_drift": op_drifts}
 
 
 # --------------------------------------------------------------- check mode
