@@ -26,6 +26,7 @@ import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+from flexflow_tpu import health
 from flexflow_tpu import telemetry as tel
 from flexflow_tpu.core.graph import topo_order
 from flexflow_tpu.core.tensor import Tensor
@@ -118,7 +119,8 @@ def compile_model(model, optimizer, loss_type: LossType, metrics: Sequence[Metri
     # the current state untouched (disabling is an explicit
     # telemetry.shutdown(), never a side effect of a later compile)
     if getattr(cfg, "telemetry_dir", ""):
-        tel.configure(cfg.telemetry_dir)
+        tel.configure(cfg.telemetry_dir,
+                      max_mb=getattr(cfg, "telemetry_max_mb", None))
     # --fault-plan arms the deterministic fault injector (FF_FAULT_PLAN is
     # read at faults import; an explicit config plan overrides it)
     if getattr(cfg, "fault_plan", ""):
@@ -371,6 +373,13 @@ class CompiledModel:
         # per epoch — drift_stats() medians these against the strategy's
         # predicted step time
         self._drift_windows: List[tuple] = []
+        # run-health layer (flexflow_tpu/health.py, ISSUE 9): goodput
+        # meter is per-fit (rebuilt by _fit), the HBM watermark tracker
+        # spans the compile's lifetime (init + every epoch boundary), and
+        # the sentinel monitor follows cfg.health_sentinels
+        self._goodput: Optional[health.GoodputMeter] = None
+        self._watermarks = health.WatermarkTracker()
+        self._sentinels: Optional[health.SentinelMonitor] = None
 
         self.forward_fn = build_forward(model.layers, model.input_tensors, outputs,
                                         mesh, strategy,
@@ -500,6 +509,8 @@ class CompiledModel:
         self.opt_state = jax.jit(self.tx.init,
                                  out_shardings=self._opt_sh)(self.params)
         self._iteration = 0
+        # first HBM watermark: the persistent footprint right after init
+        self._watermarks.sample("init", (self.params, self.opt_state))
         return self.params
 
     # ---------------------------------------------------------------- steps
@@ -514,6 +525,13 @@ class CompiledModel:
         precision = None if self.cfg.allow_tensor_op_math_conversion else "highest"
 
         regularizers = dict(self.model._weight_regularizers)
+        # numerics sentinels (flexflow_tpu/health.py): fold the grad
+        # global-norm + non-finite flag into the step's metric outputs —
+        # they ride the deferred-metrics machinery (sums/means across
+        # fused and accumulated steps), so the healthy path pays zero
+        # extra host syncs; the fit loop pops the reserved keys off
+        # before user-facing metric accounting
+        sentinels = bool(getattr(self.cfg, "health_sentinels", False))
 
         # ZeRO machinery: the moment/opt-state sharding trees are fixed by
         # (strategy, mesh, optimizer), so build them once per compile and
@@ -565,6 +583,9 @@ class CompiledModel:
                 params, state, inputs, label, rng)
             params, opt_state = apply_update(params, opt_state, grads)
             mvals = compute_metrics(metric_types, logits.astype(jnp.float32), label)
+            if sentinels:
+                mvals = dict(mvals, **health.sentinel_metrics(
+                    loss, optax.global_norm(grads)))
             return params, opt_state, new_state, loss, mvals
 
         def accum_step(params, opt_state, state, inputs, label, rng):
@@ -606,8 +627,12 @@ class CompiledModel:
             inv = 1.0 / accum
             g = jax.tree_util.tree_map(lambda t: t * inv, g)
             params, opt_state = apply_update(params, opt_state, g)
-            return params, opt_state, s, lsum * inv, \
-                jax.tree_util.tree_map(lambda x: x * inv, msum)
+            loss = lsum * inv
+            mvals = jax.tree_util.tree_map(lambda x: x * inv, msum)
+            if sentinels:
+                mvals = dict(mvals, **health.sentinel_metrics(
+                    loss, optax.global_norm(g)))
+            return params, opt_state, s, loss, mvals
 
         step_fn = accum_step if accum > 1 else train_step
 
@@ -767,7 +792,13 @@ class CompiledModel:
             # effective (per-call) knobs, not cfg: they define what the
             # manifest's progress counters mean, for save AND resume check
             res.set_effective(batch_size, self._accum_steps)
+        # goodput accounting (flexflow_tpu/health.py): one meter per fit;
+        # restore-from-checkpoint time is the "resume" bucket (it happens
+        # before any epoch wall-clock starts)
+        gm = self._goodput = health.GoodputMeter()
+        t_res = time.perf_counter()
         progress = res.resume_now(verbose) if res is not None else None
+        gm.add("resume", time.perf_counter() - t_res)
         loader = SingleDataLoader(xs, y, batch_size, shuffle=True, seed=self.cfg.seed)
         in_sh = [self.input_sharding(t) for t in self.model.input_tensors]
         lab_sh = self.label_sharding((batch_size,) + tuple(np.asarray(y).shape[1:]))
@@ -788,7 +819,8 @@ class CompiledModel:
             history = self._fit_epochs(epochs, loader, in_sh, lab_sh,
                                        base_rng, batch_size, callbacks,
                                        verbose, sync_every,
-                                       steps_per_dispatch, res, progress)
+                                       steps_per_dispatch, res, progress,
+                                       gm)
         finally:
             if prof_ctx is not None:
                 prof_ctx.__exit__(None, None, None)
@@ -822,7 +854,7 @@ class CompiledModel:
 
     def _fit_epochs(self, epochs, loader, in_sh, lab_sh, base_rng,
                     batch_size, callbacks, verbose, sync_every,
-                    steps_per_dispatch, res=None, progress=None):
+                    steps_per_dispatch, res=None, progress=None, gm=None):
         """Asynchronous training pipeline (the Legion async-launch analog):
         the host's only per-step work is folding the rng key and issuing
         the next dispatch — loss/metrics stay device-resident (deferred
@@ -856,6 +888,20 @@ class CompiledModel:
 
         policy = res.policy if res is not None \
             else RetryPolicy.from_config(self.cfg)
+        # run-health layer: the goodput meter buckets the loop's
+        # wall-clock via its lap cursor (always on — a handful of
+        # perf_counter calls per DISPATCH, not per step), and the
+        # sentinel monitor strips the step functions' health/* outputs
+        # into its own deferred window, checked only at the loop's
+        # existing materialization points
+        if gm is None:
+            gm = self._goodput = health.GoodputMeter()
+        sent = None
+        if getattr(self.cfg, "health_sentinels", False):
+            sent = health.SentinelMonitor(
+                halt=bool(getattr(self.cfg, "halt_on_nonfinite", False)),
+                checkpoint_root=res.root if res is not None else None)
+        self._sentinels = sent
         start_epoch, skip_steps, history = start_state(progress)
         if progress:
             # the dataloader cursor: epochs 0..start_epoch-1 consumed their
@@ -907,6 +953,7 @@ class CompiledModel:
               multi = self._get_multi(k) if k > 1 else None
               pm = PerfMetrics()
               t0 = time.perf_counter()
+              gm.tick()  # arm the goodput lap cursor at the epoch wall
               # loss rides a second deferred PerfMetrics keyed by STEPS (not
               # samples): device chunk-folding bounds memory on long epochs.
               # Parity with the old `loss_sum += float(loss)` loop is
@@ -937,6 +984,11 @@ class CompiledModel:
                   pm.sums = {mk: float(mv) for mk, mv in
                              (progress.get("metric_sums") or {}).items()}
                   pm.train_all = seed_samples = int(progress.get("samples", 0))
+              if sent is not None:
+                  # per-epoch loss-window baseline (re-seeded on resume so
+                  # pre-snapshot loss mass can't look like a spike)
+                  sent._loss_sum_prev = pml.sums.get("loss", 0.0)
+                  sent._steps_prev = nb
               ep_disp = ep_sync = 0
               since_sync = 0
               gen = prefetch_multi(
@@ -963,6 +1015,7 @@ class CompiledModel:
                       tel.record("fit/prefetch_wait", t_w, cat="fit")
                   else:
                       item = next(gen, None)
+                  gm.lap("prefetch_wait")
                   if item is None:
                       break
                   kind, dx, dy = item
@@ -978,6 +1031,19 @@ class CompiledModel:
                                      + (k if kind == "k" else 1)):
                           run_resilient("fit/dispatch", lambda: None,
                                         policy, index=s)
+                          # health/nonfinite: SILENT corruption — NaN-
+                          # poison the first param leaf instead of
+                          # raising, so the numerics sentinel (not an
+                          # exception) must catch the blow-up
+                          if _faults.poison("health/nonfinite", index=s):
+                              leaves, tdef = jax.tree_util.tree_flatten(
+                                  self.params)
+                              if leaves:
+                                  leaves[0] = leaves[0] * jnp.float32(
+                                      np.nan)
+                                  self.params = \
+                                      jax.tree_util.tree_unflatten(
+                                          tdef, leaves)
                   if rec:
                       t_d = tel.now_us()
                   ann = prof("train", step_num=self._iteration) \
@@ -997,6 +1063,7 @@ class CompiledModel:
                                                     self.opt_state,
                                                     self.state, dx, dy, rng)
                           steps = 1
+                  gm.lap("dispatch")
                   self._iteration += steps
                   nb += steps
                   since_sync += steps
@@ -1005,19 +1072,29 @@ class CompiledModel:
                   if rec:
                       tel.record("fit/dispatch", t_d, cat="fit", kind=kind,
                                  steps=steps, iteration=self._iteration)
+                  if sent is not None:
+                      sent.push(steps, mvals)  # strips health/* keys
                   pml.update_deferred(steps, {"loss": loss})
                   pm.update_deferred(batch_size * accum * steps, mvals)
+                  gm.lap("loop")
                   if sync and since_sync >= sync:
                       if rec:
                           t_s = tel.now_us()
                       pml.materialize()
                       pm.materialize()
+                      if sent is not None:
+                          # sentinel window check rides the EXISTING sync
+                          # (no extra materialization point)
+                          sent.check(self._iteration,
+                                     loss_sum=pml.sums.get("loss", 0.0),
+                                     steps_total=nb)
                       if rec:
                           tel.record("fit/host_sync", t_s, cat="fit",
                                      iteration=self._iteration)
                       stats["host_syncs"] += 1
                       ep_sync += 1
                       since_sync = 0
+                      gm.lap("host_sync")
                   elif ep_disp % ahead == 0:
                       # bounded dispatch-ahead: wait for the device to catch
                       # up (no host transfer, just a queue-depth barrier)
@@ -1028,8 +1105,10 @@ class CompiledModel:
                           tel.record("fit/barrier_sync", t_b, cat="fit",
                                      iteration=self._iteration)
                       stats["barriers"] += 1
+                      gm.lap("barrier")
                   if res is not None:
                       res.maybe_checkpoint(loss, make_progress)
+                      gm.lap("checkpoint")
                   for cb in per_batch_cbs:
                       cb.on_batch_end(self._iteration, {"loss": float(loss)})
                   if kind == "1":
@@ -1039,9 +1118,14 @@ class CompiledModel:
               if rec:
                   t_s = tel.now_us()
               pml.materialize()
+              if sent is not None:
+                  sent.check(self._iteration,
+                             loss_sum=pml.sums.get("loss", 0.0),
+                             steps_total=nb)
               if rec:
                   tel.record("fit/host_sync", t_s, cat="fit",
                              scope="epoch_end")
+              gm.lap("host_sync")
               dt = time.perf_counter() - t0
               # drift/throughput count only work executed THIS session: a
               # resumed epoch's re-seeded steps/samples ran before the
@@ -1050,6 +1134,12 @@ class CompiledModel:
               if rec:
                   tel.record("fit/epoch", tel.now_us() - dt * 1e6,
                              cat="fit", epoch=epoch, steps=nb)
+              grec = gm.epoch_end(dt, epoch)
+              # HBM watermark at the epoch boundary (outside the epoch
+              # wall; memory_stats() on real backends, live-buffer bytes
+              # on the CPU twin)
+              self._watermarks.sample(f"epoch{epoch}",
+                                      (self.params, self.opt_state))
               summ = pm.summary()
               summ["loss"] = pml.sums.get("loss", 0.0) / max(1, nb)
               summ["epoch_time_s"] = dt
@@ -1057,6 +1147,7 @@ class CompiledModel:
                   if dt > 0 else 0.0
               summ["dispatches"] = float(ep_disp)
               summ["host_syncs"] = float(ep_sync)
+              summ["goodput"] = grec["goodput"]
               history.append(summ)
               if verbose:
                   ms = " ".join(f"{k_}={v:.4f}" for k_, v in summ.items()
@@ -1217,6 +1308,25 @@ class CompiledModel:
         return tel.drift_stats(self.predicted_step_time(),
                                list(self._drift_windows))
 
+    def goodput_report(self) -> dict:
+        """The last fit's wall-clock bucket accounting (see
+        health.GoodputMeter.report): per-bucket seconds, goodput%, the
+        unattributed residual, and the accounted fraction. Empty dict
+        before any fit."""
+        return self._goodput.report() if self._goodput is not None else {}
+
+    def health_report(self) -> dict:
+        """Run-health summary: sentinel detector status (nonfinite /
+        spike counts) and the HBM watermark vs the cost model's
+        predicted per-device footprint (health.watermark_drift)."""
+        sent = self._sentinels.state.status() \
+            if self._sentinels is not None else None
+        wm = None
+        if self._watermarks.samples:
+            pred = self.memory_stats()["predicted_weight_state_bytes"]
+            wm = self._watermarks.report(pred)
+        return {"sentinels": sent, "watermarks": wm}
+
     def op_attribution(self, step_time_s: Optional[float] = None,
                        source: str = "auto", top: int = 0,
                        print_table: bool = True) -> dict:
@@ -1318,6 +1428,16 @@ class CompiledModel:
                   f"{mem['actual_opt_state_bytes_per_device'] / mb:.2f}MB")
             for line in tel.format_drift(self.drift_stats()):
                 print(line)
+            if self._goodput is not None and self._goodput.epochs:
+                for line in health.format_goodput(self._goodput.report()):
+                    print(line)
+            wm = self._watermarks.report(
+                mem["predicted_weight_state_bytes"]) \
+                if self._watermarks.samples else None
+            sent = self._sentinels.state.status() \
+                if self._sentinels is not None else None
+            for line in health.format_health(sent, wm):
+                print(line)
             if self.cfg.profile_ops:
                 # --profile-ops: the full attribution join (measured vs
                 # predicted vs roofline, MFU, per-op drift top-K)
@@ -1365,6 +1485,9 @@ class CompiledModel:
                                             compute_dtype=self.cfg.compute_dtype,
                                             enable_fusion=self.cfg.enable_fusion)
             self._build_steps()
+            if self._goodput is not None:
+                # charge the rebuild to the recompile goodput bucket
+                self._goodput.lap("recompile")
 
     # ----------------------------------------------------------- checkpoint
     def save_checkpoint(self, path: str, block: Optional[bool] = None) -> str:

@@ -156,6 +156,18 @@ class FFConfig:
     # and async checkpointing, rendered by tools/trace_report.py into a
     # span summary + Chrome trace. "" = disabled (near-zero overhead).
     telemetry_dir: str = ""
+    # size cap per telemetry JSONL segment in MB (flexflow_tpu/health.py
+    # era): long elastic runs rotate to telemetry-<pid>.<seq>.jsonl past
+    # this; readers (trace_report / span_dataset / monitor) merge segments
+    # transparently. 0 = unbounded (the pre-rotation behavior).
+    telemetry_max_mb: float = 512.0
+    # numerics sentinels (flexflow_tpu/health.py): device-resident
+    # finite-checks + grad-norm/loss-spike detectors folded into the
+    # deferred metrics (zero extra host syncs); halt_on_nonfinite escalates
+    # a NaN/Inf window to NonFiniteError through the checkpoint drain so
+    # the last durable checkpoint is the recovery point
+    health_sentinels: bool = True
+    halt_on_nonfinite: bool = False
     export_dot: str = ""  # --compgraph analog
     include_costs_dot_graph: bool = False
     # chrome-trace export of the COMPILED strategy's event-driven replay
@@ -243,6 +255,10 @@ class FFConfig:
         p.add_argument("--profile-dir", type=str, default="")
         p.add_argument("--profile-ops", action="store_true")
         p.add_argument("--telemetry-dir", type=str, default="")
+        p.add_argument("--telemetry-max-mb", type=float, default=512.0)
+        p.add_argument("--health-sentinels",
+                       action=argparse.BooleanOptionalAction, default=True)
+        p.add_argument("--halt-on-nonfinite", action="store_true")
         p.add_argument("--compute-dtype", type=str, default="float32")
         p.add_argument("--remat", action="store_true")
         p.add_argument("--compgraph", dest="export_dot", type=str, default="")
@@ -336,6 +352,9 @@ class FFConfig:
             profile_dir=args.profile_dir,
             profile_ops=args.profile_ops,
             telemetry_dir=args.telemetry_dir,
+            telemetry_max_mb=args.telemetry_max_mb,
+            health_sentinels=args.health_sentinels,
+            halt_on_nonfinite=args.halt_on_nonfinite,
             compute_dtype=args.compute_dtype,
             remat=args.remat,
             export_dot=args.export_dot,

@@ -1,0 +1,482 @@
+"""Run health: goodput accounting, numerics sentinels, HBM watermarks.
+
+Motivation (ISSUE 9): PR 5's spans and PR 7's per-op attribution answer
+"how fast does each op run", but not the production questions a long
+elastic run raises — what fraction of wall-clock was USEFUL training
+(vs checkpoint snapshots, input stalls, pipeline bubbles, resume and
+recompile overhead), is the run numerically healthy (NaN/Inf, exploding
+grad norms, loss spikes), and did memory land where the search predicted.
+This module is that layer; both fit loops (compiler/compile.py
+_fit_epochs and parallel/pipeline.py PipelinedModel.fit) wire into it,
+and tools/monitor.py renders its `health/*` telemetry events live.
+
+Three pieces:
+
+  * `GoodputMeter` — classifies fit wall-clock into named buckets with a
+    contiguous lap cursor (every perf_counter interval between two lap()
+    calls lands in exactly one bucket, so the buckets tile the loop's
+    wall and the unattributed residual stays small and explicit).
+    Goodput% counts the compute-facing buckets (dispatch + host_sync +
+    barrier — in the async dispatch-ahead regime those are precisely the
+    periods the host is issuing or waiting on device compute) minus the
+    pipeline-bubble carve-out; input stalls, checkpointing, resume /
+    recompile overhead, host bookkeeping, and the residual are lost time.
+  * `SentinelMonitor` / `SentinelState` — device-resident finite-checks
+    and grad-norm/loss spike detectors. The step functions fold
+    `health/grad_norm` and `health/nonfinite` scalars into their metric
+    outputs (riding the existing deferred-metrics machinery), and the
+    monitor only materializes them at the loop's EXISTING sync points —
+    zero extra host syncs on the healthy path. A fatal NaN/Inf emits a
+    `health/nonfinite` error event and, under --halt-on-nonfinite,
+    raises `NonFiniteError` through the checkpoint drain so the last
+    durable checkpoint is the recovery point (runtime/resilience.py).
+  * `WatermarkTracker` — per-device live/peak memory sampled at compile
+    and epoch boundaries (device.memory_stats() where the backend has it,
+    summed addressable-shard bytes as the CPU fallback) compared against
+    the search's memory_stats() prediction with drift warnings.
+
+Telemetry events (cat "health"): health/goodput (per epoch),
+health/grad_spike, health/loss_spike, health/hbm; cat "error":
+health/nonfinite, health/halt.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from flexflow_tpu import telemetry as tel
+from flexflow_tpu.metrics import PerfMetrics
+
+# ------------------------------------------------------------------ goodput
+# bucket names (GoodputMeter.lap / add): every second of fit wall-clock
+# should land in one of these, with the leftover reported as "residual"
+BUCKETS = (
+    "dispatch",        # issuing jitted step dispatches (device compute)
+    "prefetch_wait",   # blocked on the input pipeline (data stall)
+    "host_sync",       # deferred-metric materialization (device wait)
+    "barrier",         # dispatch-ahead block_until_ready (device wait)
+    "loop",            # host-side bookkeeping between dispatches
+    "checkpoint",      # snapshot + drain on the fit thread
+    "resume",          # restore_auto / checkpoint load before epoch 0
+    "recompile",       # recompile_on_condition rebuilds mid-fit
+)
+# compute-facing buckets: counted as productive before the bubble carve-out
+PRODUCTIVE = ("dispatch", "host_sync", "barrier")
+
+
+class GoodputMeter:
+    """Wall-clock bucket accounting for one fit.
+
+    The lap cursor makes the accounting contiguous: `tick()` arms it,
+    and each `lap(bucket)` charges the interval since the previous
+    lap/tick to `bucket`. `add(bucket, s)` credits out-of-band time
+    (resume before the loop starts). `epoch_end()` closes the epoch:
+    derives the pipeline-bubble carve-out from the dispatch bucket,
+    computes goodput% and the unattributed residual, emits the
+    `health/goodput` event, and resets for the next epoch."""
+
+    def __init__(self) -> None:
+        self._acc: Dict[str, float] = {b: 0.0 for b in BUCKETS}
+        self._last: Optional[float] = None
+        self.epochs: List[Dict[str, Any]] = []
+
+    def tick(self) -> None:
+        self._last = time.perf_counter()
+
+    def lap(self, bucket: str) -> None:
+        now = time.perf_counter()
+        if self._last is not None:
+            self._acc[bucket] = self._acc.get(bucket, 0.0) \
+                + (now - self._last)
+        self._last = now
+
+    def add(self, bucket: str, seconds: float) -> None:
+        if seconds > 0.0:
+            self._acc[bucket] = self._acc.get(bucket, 0.0) + seconds
+
+    def epoch_end(self, wall_s: float, epoch: int,
+                  bubble_frac: Optional[float] = None) -> Dict[str, Any]:
+        acc, self._acc = self._acc, {b: 0.0 for b in BUCKETS}
+        self._last = None
+        bubble_s = (float(bubble_frac) if bubble_frac else 0.0) \
+            * acc.get("dispatch", 0.0)
+        accounted = sum(acc.values())
+        residual = max(0.0, wall_s - accounted)
+        productive = sum(acc.get(b, 0.0) for b in PRODUCTIVE) - bubble_s
+        rec: Dict[str, Any] = {
+            "epoch": int(epoch),
+            "wall_s": float(wall_s),
+            "buckets": {k: float(v) for k, v in acc.items()},
+            "bubble_s": float(bubble_s),
+            "residual_s": float(residual),
+            "accounted_frac": (accounted / wall_s) if wall_s > 0 else 0.0,
+            "goodput": max(0.0, min(1.0, productive / wall_s))
+            if wall_s > 0 else 0.0,
+        }
+        self.epochs.append(rec)
+        if tel.enabled():
+            args: Dict[str, Any] = {
+                "epoch": rec["epoch"], "wall_s": rec["wall_s"],
+                "goodput": rec["goodput"],
+                "residual_s": rec["residual_s"],
+                "bubble_s": rec["bubble_s"],
+            }
+            for k, v in acc.items():
+                if v > 0.0:
+                    args[k + "_s"] = v
+            tel.event("health/goodput", cat="health", **args)
+        return rec
+
+    def report(self) -> Dict[str, Any]:
+        """Fit-level aggregate over the closed epochs."""
+        wall = sum(e["wall_s"] for e in self.epochs)
+        buckets: Dict[str, float] = {b: 0.0 for b in BUCKETS}
+        for e in self.epochs:
+            for k, v in e["buckets"].items():
+                buckets[k] = buckets.get(k, 0.0) + v
+        bubble = sum(e["bubble_s"] for e in self.epochs)
+        residual = sum(e["residual_s"] for e in self.epochs)
+        productive = sum(buckets.get(b, 0.0) for b in PRODUCTIVE) - bubble
+        return {
+            "epochs": len(self.epochs),
+            "wall_s": wall,
+            "buckets": buckets,
+            "bubble_s": bubble,
+            "residual_s": residual,
+            "accounted_frac": (sum(buckets.values()) / wall)
+            if wall > 0 else 0.0,
+            "goodput": max(0.0, min(1.0, productive / wall))
+            if wall > 0 else 0.0,
+        }
+
+
+def format_goodput(rep: Dict[str, Any]) -> List[str]:
+    """The `[goodput]` report lines (profile_report + bench share this)."""
+    if not rep or not rep.get("epochs"):
+        return ["[goodput] no closed fit epochs yet (run fit())"]
+    wall = rep["wall_s"] or 1e-12
+    parts = " ".join(
+        f"{k}={100.0 * v / wall:.1f}%" for k, v in
+        sorted(rep["buckets"].items(), key=lambda kv: -kv[1]) if v > 0.0)
+    lines = [f"[goodput] {100.0 * rep['goodput']:.1f}% of "
+             f"{wall:.2f}s wall over {rep['epochs']} epoch(s) "
+             f"(accounted {100.0 * rep['accounted_frac']:.1f}%, "
+             f"residual {rep['residual_s']:.3f}s)",
+             f"[goodput] buckets: {parts or '(none)'}"]
+    if rep.get("bubble_s"):
+        lines.append(f"[goodput] pipeline bubble carve-out: "
+                     f"{rep['bubble_s']:.3f}s "
+                     f"({100.0 * rep['bubble_s'] / wall:.1f}% of wall)")
+    return lines
+
+
+# ---------------------------------------------------------------- sentinels
+# reserved metric keys the step functions fold into their metric outputs;
+# both fit loops pop them off before user-facing metric accounting
+GRAD_NORM_KEY = "health/grad_norm"
+NONFINITE_KEY = "health/nonfinite"
+SENTINEL_KEYS = (GRAD_NORM_KEY, NONFINITE_KEY)
+
+# spike thresholds: a window mean this many times the trailing EMA (grad
+# norm) / the previous window mean (loss) emits a health/*_spike warning
+GRAD_SPIKE_RATIO = 10.0
+LOSS_SPIKE_RATIO = 4.0
+_EMA_DECAY = 0.9
+
+
+def sentinel_metrics(loss, grad_norm) -> Dict[str, Any]:
+    """Device-side sentinel scalars for one optimizer update (called
+    inside the jitted step functions): the grad global-norm and a 0/1
+    non-finite flag over (loss, grad_norm). Means of the flag across
+    fused/accumulated steps stay > 0 iff ANY step tripped (NaN also
+    propagates through the mean), so deferred accumulation preserves
+    detection."""
+    import jax.numpy as jnp
+
+    gn = grad_norm.astype(jnp.float32)
+    ls = loss.astype(jnp.float32)
+    finite = jnp.isfinite(ls) & jnp.isfinite(gn)
+    return {GRAD_NORM_KEY: gn,
+            NONFINITE_KEY: 1.0 - finite.astype(jnp.float32)}
+
+
+class NonFiniteError(RuntimeError):
+    """Fatal numerics failure (--halt-on-nonfinite): raised through the
+    checkpoint drain, carrying the last DURABLE checkpoint path — the
+    recovery point a supervisor resumes from (the in-memory state is
+    poisoned and deliberately NOT saved)."""
+
+    def __init__(self, step: int, checkpoint: Optional[str],
+                 detail: str = ""):
+        self.step = int(step)
+        self.checkpoint = checkpoint
+        msg = (f"non-finite loss/grad detected at step {step}"
+               + (f" ({detail})" if detail else ""))
+        msg += (f"; last durable checkpoint: {checkpoint}" if checkpoint
+                else "; no durable checkpoint available")
+        super().__init__(msg)
+
+
+def halt_nonfinite(step: int, checkpoint_root: Optional[str],
+                   detail: str = "") -> "NoReturn":  # noqa: F821
+    """The PR-6 drain path for a fatal sentinel: join in-flight async
+    checkpoint writes (so a durable save racing the failure lands), look
+    up the newest durable checkpoint, emit the health/halt error event,
+    and raise NonFiniteError. The poisoned live state is NOT saved."""
+    from flexflow_tpu.runtime import checkpoint as ck
+    from flexflow_tpu.runtime.resilience import latest_checkpoint
+
+    ck.wait_pending()
+    last = latest_checkpoint(checkpoint_root) if checkpoint_root else None
+    tel.error("health/halt", step=int(step), checkpoint=last,
+              detail=detail or None)
+    raise NonFiniteError(step, last, detail)
+
+
+class SentinelState:
+    """Host-side spike/NaN detectors over materialized window means.
+    Pure accounting (feed it floats, read `.events`) so tests drive it
+    without a device."""
+
+    def __init__(self, grad_ratio: float = GRAD_SPIKE_RATIO,
+                 loss_ratio: float = LOSS_SPIKE_RATIO):
+        self.grad_ratio = float(grad_ratio)
+        self.loss_ratio = float(loss_ratio)
+        self.grad_ema: Optional[float] = None
+        self.loss_prev: Optional[float] = None
+        self.nonfinite_steps = 0
+        self.events: List[Dict[str, Any]] = []
+
+    def observe(self, step: int, loss_mean: Optional[float] = None,
+                grad_norm: Optional[float] = None,
+                nonfinite: float = 0.0) -> Optional[str]:
+        """One materialized window. Returns "nonfinite" on a fatal
+        window, else None (spikes are warnings, not fatal)."""
+        fatal = (nonfinite is not None and nonfinite > 0.0) \
+            or (nonfinite != nonfinite)  # NaN count is itself a trip
+        if not fatal and grad_norm is not None \
+                and grad_norm != grad_norm:
+            fatal = True
+        if fatal:
+            self.nonfinite_steps += 1
+            ev = {"kind": "nonfinite", "step": int(step),
+                  "grad_norm": grad_norm, "loss": loss_mean}
+            self.events.append(ev)
+            tel.error("health/nonfinite", step=int(step),
+                      grad_norm=grad_norm, loss=loss_mean)
+            return "nonfinite"
+        if grad_norm is not None:
+            if self.grad_ema is not None \
+                    and grad_norm > self.grad_ratio * max(self.grad_ema,
+                                                          1e-12):
+                ev = {"kind": "grad_spike", "step": int(step),
+                      "grad_norm": grad_norm, "ema": self.grad_ema}
+                self.events.append(ev)
+                tel.event("health/grad_spike", cat="health",
+                          step=int(step), grad_norm=grad_norm,
+                          ema=self.grad_ema)
+            self.grad_ema = grad_norm if self.grad_ema is None else \
+                _EMA_DECAY * self.grad_ema + (1 - _EMA_DECAY) * grad_norm
+        if loss_mean is not None and loss_mean == loss_mean:
+            if self.loss_prev is not None \
+                    and abs(loss_mean) > self.loss_ratio \
+                    * max(abs(self.loss_prev), 1e-12):
+                ev = {"kind": "loss_spike", "step": int(step),
+                      "loss": loss_mean, "prev": self.loss_prev}
+                self.events.append(ev)
+                tel.event("health/loss_spike", cat="health",
+                          step=int(step), loss=loss_mean,
+                          prev=self.loss_prev)
+            self.loss_prev = loss_mean
+        return None
+
+    def status(self) -> Dict[str, Any]:
+        return {
+            "nonfinite_steps": self.nonfinite_steps,
+            "grad_spikes": sum(1 for e in self.events
+                               if e["kind"] == "grad_spike"),
+            "loss_spikes": sum(1 for e in self.events
+                               if e["kind"] == "loss_spike"),
+            "grad_ema": self.grad_ema,
+        }
+
+
+class SentinelMonitor:
+    """The fit loop's sentinel harness: `push()` strips the reserved
+    health keys off a dispatch's metric dict into a deferred PerfMetrics
+    (no host transfer), and `check()` materializes the window ONLY at
+    the loop's existing sync points, runs the detectors, and — under
+    halt_on_nonfinite — raises via the drain path."""
+
+    def __init__(self, halt: bool = False,
+                 checkpoint_root: Optional[str] = None,
+                 state: Optional[SentinelState] = None):
+        self.halt = bool(halt)
+        self.checkpoint_root = checkpoint_root
+        self.state = state or SentinelState()
+        self._win = PerfMetrics()
+        self._loss_sum_prev = 0.0
+        self._steps_prev = 0
+
+    def push(self, steps: int, mvals: Dict[str, Any]) -> None:
+        """Pop health/* device scalars out of `mvals` (mutates it — the
+        user-facing metric accounting must not see reserved keys) and
+        queue them deferred."""
+        h = {k: mvals.pop(k) for k in SENTINEL_KEYS if k in mvals}
+        if h:
+            self._win.update_deferred(int(steps), h)
+
+    def check(self, step: int, loss_sum: Optional[float] = None,
+              steps_total: Optional[int] = None) -> Optional[str]:
+        """Materialize the window (call ONLY where the loop already
+        syncs) and run the detectors. `loss_sum`/`steps_total` are the
+        loop's running loss accumulator + step count; window means are
+        the deltas since the previous check."""
+        w, self._win = self._win, PerfMetrics()
+        w.materialize()
+        n = max(1, w.train_all)
+        gsum = w.sums.get(GRAD_NORM_KEY)
+        nf = w.sums.get(NONFINITE_KEY, 0.0)
+        loss_mean = None
+        if loss_sum is not None and steps_total is not None:
+            dn = steps_total - self._steps_prev
+            if dn > 0:
+                loss_mean = (loss_sum - self._loss_sum_prev) / dn
+            self._loss_sum_prev = float(loss_sum)
+            self._steps_prev = int(steps_total)
+        verdict = self.state.observe(
+            step, loss_mean=loss_mean,
+            grad_norm=(gsum / n) if gsum is not None else None,
+            nonfinite=nf)
+        if verdict == "nonfinite" and self.halt:
+            halt_nonfinite(step, self.checkpoint_root,
+                           detail=f"nonfinite window mean {nf / n:g}")
+        return verdict
+
+
+# --------------------------------------------------------------- watermarks
+# actual peak memory beyond this multiple of the search's prediction flags
+# the memory model as under-predicting (the inverse of OOM headroom)
+WATERMARK_WARN_RATIO = 1.5
+
+
+def device_watermarks(trees: Sequence[Any] = ()) -> Dict[str, Dict[str, int]]:
+    """Per-device live/peak byte sample. TPU/GPU backends expose
+    device.memory_stats(); the CPU backend doesn't, so the fallback sums
+    the addressable-shard bytes of the live trees the caller passes
+    (params/opt state — the persistent footprint, matching what
+    memory_stats() predicts)."""
+    import jax
+
+    out: Dict[str, Dict[str, int]] = {}
+    for d in jax.local_devices():
+        stats = None
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        if stats and stats.get("bytes_in_use") is not None:
+            out[str(d.id)] = {
+                "live": int(stats["bytes_in_use"]),
+                "peak": int(stats.get("peak_bytes_in_use",
+                                      stats["bytes_in_use"])),
+            }
+    if out:
+        return out
+    totals: Dict[str, int] = {}
+    for tree in trees:
+        if tree is None:
+            continue
+        for leaf in jax.tree_util.tree_leaves(tree):
+            shards = getattr(leaf, "addressable_shards", None)
+            if shards is None:
+                continue
+            for s in shards:
+                k = str(s.device.id)
+                totals[k] = totals.get(k, 0) + int(s.data.nbytes)
+    return {k: {"live": v, "peak": v} for k, v in totals.items()}
+
+
+def watermark_drift(peak_bytes: Optional[int],
+                    predicted_bytes: Optional[int],
+                    warn_ratio: float = WATERMARK_WARN_RATIO
+                    ) -> Dict[str, Any]:
+    """Pure comparison: measured per-device peak vs the search's
+    prediction. warn trips when the model UNDER-predicted by more than
+    `warn_ratio` (the direction that OOMs a real machine)."""
+    ratio = None
+    if peak_bytes and predicted_bytes:
+        ratio = float(peak_bytes) / float(predicted_bytes)
+    return {
+        "peak_bytes": int(peak_bytes) if peak_bytes else None,
+        "predicted_bytes": int(predicted_bytes) if predicted_bytes
+        else None,
+        "ratio": ratio,
+        "warn": bool(ratio is not None and ratio > warn_ratio),
+        "warn_ratio": float(warn_ratio),
+    }
+
+
+class WatermarkTracker:
+    """HBM watermark sampler: `sample()` at compile and epoch boundaries,
+    `report(predicted)` compares the peak against the cost model."""
+
+    def __init__(self) -> None:
+        self.samples: List[Dict[str, Any]] = []
+
+    def sample(self, tag: str, trees: Sequence[Any] = ()
+               ) -> Dict[str, Any]:
+        per_dev = device_watermarks(trees)
+        peak = max((v["peak"] for v in per_dev.values()), default=0)
+        live = max((v["live"] for v in per_dev.values()), default=0)
+        rec = {"tag": str(tag), "per_device": per_dev,
+               "peak_bytes": peak, "live_bytes": live}
+        self.samples.append(rec)
+        if tel.enabled() and per_dev:
+            tel.event("health/hbm", cat="health", tag=str(tag),
+                      peak_bytes=peak, live_bytes=live,
+                      devices=len(per_dev))
+        return rec
+
+    def peak_bytes(self) -> Optional[int]:
+        peaks = [s["peak_bytes"] for s in self.samples if s["per_device"]]
+        return max(peaks) if peaks else None
+
+    def report(self, predicted_bytes: Optional[int],
+               warn_ratio: float = WATERMARK_WARN_RATIO
+               ) -> Dict[str, Any]:
+        rep = watermark_drift(self.peak_bytes(), predicted_bytes,
+                              warn_ratio)
+        rep["samples"] = len(self.samples)
+        return rep
+
+
+def format_health(sentinels: Optional[Dict[str, Any]],
+                  watermarks: Optional[Dict[str, Any]]) -> List[str]:
+    """The `[health]` report lines (profile_report; bench reuses)."""
+    lines: List[str] = []
+    if sentinels is not None:
+        nf = sentinels.get("nonfinite_steps", 0)
+        lines.append(
+            f"[health] sentinels: nonfinite_windows={nf} "
+            f"grad_spikes={sentinels.get('grad_spikes', 0)} "
+            f"loss_spikes={sentinels.get('loss_spikes', 0)}"
+            + (" — NON-FINITE VALUES DETECTED" if nf else ""))
+    if watermarks is not None and watermarks.get("peak_bytes"):
+        mb = 1024 * 1024
+        pred = watermarks.get("predicted_bytes")
+        line = (f"[health] hbm peak/device: "
+                f"{watermarks['peak_bytes'] / mb:.2f}MB")
+        if pred:
+            line += (f" vs predicted {pred / mb:.2f}MB "
+                     f"(ratio {watermarks['ratio']:.2f}x)")
+        lines.append(line)
+        if watermarks.get("warn"):
+            lines.append(
+                f"[health] WARNING: peak memory "
+                f"{watermarks['ratio']:.2f}x the predicted footprint "
+                f"(> {watermarks['warn_ratio']:g}x) — the memory model "
+                "under-predicts this config; re-check "
+                "memory_stats()/OptMemSpec accounting")
+    return lines

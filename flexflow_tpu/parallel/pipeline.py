@@ -51,6 +51,7 @@ import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+from flexflow_tpu import health
 from flexflow_tpu import telemetry as tel
 from flexflow_tpu.core.graph import topo_order
 from flexflow_tpu.losses import LossType, compute_loss
@@ -175,6 +176,12 @@ class PipelinedModel:
         self._drift_windows: List[tuple] = []
         self._bubble_sum = 0.0
         self._bubble_n = 0
+        # run health (ISSUE 9): goodput buckets, HBM watermarks, and the
+        # numerics sentinel state of the last fit (flexflow_tpu/health.py)
+        self._goodput: Optional[health.GoodputMeter] = None
+        self._watermarks = health.WatermarkTracker()
+        self._sentinel_state: Optional[health.SentinelState] = None
+        self._gn_acc: List[Any] = []
         if jax.process_count() != 1:
             raise NotImplementedError(
                 "pipeline parallelism is single-process for now (stage "
@@ -436,17 +443,27 @@ class PipelinedModel:
             self._moment_sh.append(moment_sh)
             wsc = jax.lax.with_sharding_constraint
             tx = self.tx
+            sent_on = bool(getattr(self.cfg, "health_sentinels", False))
+            self._sentinels_on = sent_on
 
             def upd_fn(params, opt_state, gsum, inv, _moment_sh=moment_sh,
                        _pshards=pshards, _opt_sh=opt_sh):
                 g = jax.tree_util.tree_map(lambda t: t * inv, gsum)
+                # numerics sentinel (health.py): this stage's squared grad
+                # global-norm rides out as a third output — a device
+                # scalar on the STAGE mesh, accumulated there and
+                # materialized only at epoch end (cross-stage norms sum as
+                # squares; NaN/Inf propagates through the sum)
+                gn_sq = optax.global_norm(g) ** 2 if sent_on \
+                    else jnp.float32(0.0)
                 if zero != "off":
                     g = wsc(g, _moment_sh)
                 updates, opt_state = tx.update(g, opt_state, params)
                 if zero != "off":
                     updates = wsc(updates, _pshards)
                     opt_state = wsc(opt_state, _opt_sh)
-                return optax.apply_updates(params, updates), opt_state
+                return optax.apply_updates(params, updates), opt_state, \
+                    gn_sq
 
             donate = (0, 1, 2) if self.cfg.donate_state else ()
             self._f_fns.append(jax.jit(_wrap(f_fn)))
@@ -477,6 +494,10 @@ class PipelinedModel:
                     self.stage_params[s])
             self.stage_state[s] = {}
         self._iteration = 0
+        # HBM watermark at the compile/init boundary (health.py): the
+        # persistent per-stage footprint right after state materialization
+        self._watermarks.sample(
+            "init", tuple(self.stage_params) + tuple(self.stage_opt))
         return self.stage_params
 
     # ------------------------------------------------------------ the step
@@ -519,6 +540,8 @@ class PipelinedModel:
         (GPipe's flush and 1F1B's steady state differ only in per-stage op
         ORDER and stash lifetime, both encoded in the grid)."""
         S = self.num_stages
+        if self._sentinels_on and len(self._gn_acc) != S:
+            self._gn_acc = [None] * S
         stash_x: List[Dict[int, Any]] = [dict() for _ in range(S)]
         stash_st: List[Dict[int, Any]] = [dict() for _ in range(S)]
         ybuf: Dict = {}
@@ -616,9 +639,14 @@ class PipelinedModel:
         inv = 1.0 / num_micro
         for s in range(S):
             t0 = tel.now_us() if rec else 0.0
-            self.stage_params[s], self.stage_opt[s] = self._upd_fns[s](
-                self.stage_params[s], self.stage_opt[s], acc[s],
-                jnp.float32(inv))
+            self.stage_params[s], self.stage_opt[s], gn_sq = \
+                self._upd_fns[s](self.stage_params[s], self.stage_opt[s],
+                                 acc[s], jnp.float32(inv))
+            if self._sentinels_on:
+                # per-stage device-scalar accumulator (same stage mesh —
+                # cross-mesh adds are illegal); materialized at epoch end
+                a = self._gn_acc[s] if s < len(self._gn_acc) else None
+                self._gn_acc[s] = gn_sq if a is None else a + gn_sq
             if rec:
                 jax.block_until_ready(self.stage_opt[s])
                 tel.record("pipe/update", t0, cat="pipeline-update",
@@ -684,7 +712,13 @@ class PipelinedModel:
             # share res's instead of the model-lifetime default, so a
             # future per-fit retry override reaches every site
             self._retry_policy = res.policy
+        # goodput accounting for this fit (health.GoodputMeter): resume /
+        # restore time is charged out-of-band, everything inside the epoch
+        # loop through the contiguous lap cursor
+        gm = self._goodput = health.GoodputMeter()
+        t_res = time.perf_counter()
         progress = res.resume_now(verbose) if res is not None else None
+        gm.add("resume", time.perf_counter() - t_res)
         loader = SingleDataLoader(xs, y, batch_size, shuffle=True,
                                   seed=self.cfg.seed)
         lab_sh = self._label_sharding(
@@ -699,6 +733,13 @@ class PipelinedModel:
         self._drift_windows = []
         self._bubble_sum, self._bubble_n = 0.0, 0
         self._fit_id = next(_FIT_SEQ)
+        # numerics sentinels (health.py): per-stage grad-norm-sq device
+        # accumulators are checked at the loop's EXISTING epoch-end
+        # materialization — zero extra host syncs on the healthy path
+        sstate = self._sentinel_state = health.SentinelState() \
+            if self._sentinels_on else None
+        halt_on = bool(getattr(self.cfg, "halt_on_nonfinite", False))
+        self._gn_acc = [None] * self.num_stages
         start_epoch, skip_steps, history = start_state(progress)
         if progress:
             loader.advance_epochs(start_epoch)
@@ -713,6 +754,7 @@ class PipelinedModel:
               loss_sum = None
               pm = PerfMetrics()
               t0 = time.perf_counter()
+              gm.tick()
               nb = 0
               seed_steps = 0  # see the flat loop: resumed steps are not
               resuming = epoch == start_epoch and progress  # this session's work
@@ -743,6 +785,9 @@ class PipelinedModel:
                                        _pm.sums, _pm.train_all, history)
 
               for gxs, gy in grouped:
+                  # the generator's host-side gather/slicing is the input
+                  # pipeline on this path — charge it as a data stall
+                  gm.lap("prefetch_wait")
                   if M == 1:
                       gxs = [a[None] for a in gxs]
                       gy = gy[None]
@@ -754,23 +799,37 @@ class PipelinedModel:
                       run_resilient("fit/dispatch", lambda: None,
                                     self._retry_policy,
                                     index=self._iteration + 1)
+                      if _faults.poison("health/nonfinite",
+                                        index=self._iteration + 1):
+                          # silent numerics blow-up: NaN-poison one stage-0
+                          # weight; no exception — the sentinel must catch
+                          leaves, tdef = jax.tree_util.tree_flatten(
+                              self.stage_params[0])
+                          if leaves:
+                              leaves[0] = leaves[0] * jnp.float32(np.nan)
+                              self.stage_params[0] = \
+                                  jax.tree_util.tree_unflatten(tdef, leaves)
                   rng_iter = jax.random.fold_in(base_rng, self._iteration)
                   loss, mvals = self._pipeline_step(gxs, gy, lab_sh,
                                                     rng_iter, ticks, M)
+                  gm.lap("dispatch")
                   loss_sum = loss if loss_sum is None else loss_sum + loss
                   pm.update_deferred(batch_size * M, mvals)
                   self._iteration += 1
                   nb += 1
                   stats["updates"] += 1
                   stats["microbatches"] += M
+                  gm.lap("loop")
                   if nb % ahead == 0:
                       # bounded dispatch-ahead (the PR-2 fit-loop contract):
                       # don't let the host enqueue unboundedly many stage
                       # dispatches past the devices
                       jax.block_until_ready(loss)
                       stats["barriers"] = stats.get("barriers", 0) + 1
+                      gm.lap("barrier")
                   if res is not None:
                       res.maybe_checkpoint(loss, make_progress)
+                      gm.lap("checkpoint")
               dt = time.perf_counter() - t0
               self._drift_windows.append((nb - seed_steps, dt))
               if self._bubble_n:
@@ -782,11 +841,46 @@ class PipelinedModel:
                   tel.record("fit/epoch", tel.now_us() - dt * 1e6, cat="fit",
                              epoch=epoch, steps=nb)
               summ = pm.summary()
-              summ["loss"] = float(np.asarray(loss_sum)) / nb if nb else 0.0
+              loss_mean = float(np.asarray(loss_sum)) / nb if nb else 0.0
+              summ["loss"] = loss_mean
+              if sstate is not None and nb > seed_steps:
+                  # sentinel check at the EXISTING epoch-end sync: drain
+                  # the per-stage grad-norm-sq accumulators (squares sum
+                  # across stages — disjoint param partitions), RMS over
+                  # the window's updates, host-side finite check
+                  win = nb - seed_steps
+                  gn_sq_tot = 0.0
+                  for s in range(self.num_stages):
+                      if self._gn_acc[s] is not None:
+                          gn_sq_tot += float(np.asarray(self._gn_acc[s]))
+                  self._gn_acc = [None] * self.num_stages
+                  grad_norm = float(np.sqrt(gn_sq_tot / win)) \
+                      if gn_sq_tot == gn_sq_tot else float("nan")
+                  nonfinite = 0.0 if (np.isfinite(loss_mean)
+                                      and np.isfinite(grad_norm)) else 1.0
+                  verdict = sstate.observe(self._iteration,
+                                           loss_mean=loss_mean,
+                                           grad_norm=grad_norm,
+                                           nonfinite=nonfinite)
+                  if verdict == "nonfinite" and halt_on:
+                      # PR-6 drain: join pending writes, raise carrying
+                      # the last DURABLE checkpoint (the recovery point)
+                      health.halt_nonfinite(
+                          self._iteration,
+                          res.root if res is not None else None,
+                          detail="pipeline epoch-end window")
               summ["epoch_time_s"] = dt
               summ["samples_per_sec"] = ((nb - seed_steps) * M * batch_size) \
                   / dt if dt > 0 else 0.0
               summ["dispatches"] = float(nb)
+              grec = gm.epoch_end(
+                  dt, epoch,
+                  bubble_frac=(self._bubble_sum / self._bubble_n)
+                  if self._bubble_n else None)
+              summ["goodput"] = grec["goodput"]
+              self._watermarks.sample(
+                  f"epoch{epoch}",
+                  tuple(self.stage_params) + tuple(self.stage_opt))
               history.append(summ)
               if verbose:
                   ms = " ".join(f"{k}={v:.4f}" for k, v in summ.items()
@@ -974,6 +1068,28 @@ class PipelinedModel:
         return tel.drift_stats(self.predicted_step_time(),
                                list(self._drift_windows))
 
+    def goodput_report(self) -> dict:
+        """The last fit's wall-clock bucket accounting (see
+        health.GoodputMeter.report), pipeline edition — the bubble
+        carve-out uses the telemetry-measured bubble fraction when one
+        was recorded. Empty dict before any fit."""
+        return self._goodput.report() if self._goodput is not None else {}
+
+    def health_report(self) -> dict:
+        """Run-health summary, pipeline edition: sentinel status plus the
+        HBM watermark vs the heaviest stage's persistent footprint (the
+        pipeline memory report has no single-machine prediction — the
+        per-device expectation IS the max stage params+opt bytes)."""
+        sent = self._sentinel_state.status() \
+            if self._sentinel_state is not None else None
+        wm = None
+        if self._watermarks.samples:
+            mem = self.memory_stats()
+            pred = (mem["actual_param_bytes_per_device"]
+                    + mem["actual_opt_state_bytes_per_device"])
+            wm = self._watermarks.report(pred)
+        return {"sentinels": sent, "watermarks": wm}
+
     def op_attribution(self, step_time_s: Optional[float] = None,
                        source: str = "auto", top: int = 0,
                        print_table: bool = True) -> dict:
@@ -1061,6 +1177,13 @@ class PipelinedModel:
                   + (f"measured_bubble={mb:.3f}" if mb is not None
                      else "measured_bubble=n/a (enable --telemetry-dir)"))
             for line in tel.format_drift(self.drift_stats()):
+                print(line)
+            if self._goodput is not None and self._goodput.epochs:
+                for line in health.format_goodput(self._goodput.report()):
+                    print(line)
+            hrep = self.health_report()
+            for line in health.format_health(hrep["sentinels"],
+                                             hrep["watermarks"]):
                 print(line)
             if self.cfg.profile_ops:
                 self.op_attribution(print_table=True, top=top)

@@ -18,6 +18,12 @@ plan can't silently arm nothing):
   fit/dispatch          train-step dispatch admission (index = global step)
   distributed/init      jax.distributed initialization
   pipe/boundary_hop     pipeline stage-boundary activation transfer
+  health/nonfinite      NaN-poison the parameters before a step (index =
+                        1-based global step) — exercises the numerics
+                        sentinels in flexflow_tpu/health.py. This site is
+                        NON-RAISING: the fit loops query `poison()` and
+                        corrupt the params themselves, modeling a silent
+                        numerics blow-up rather than a thrown error.
 
 Plan grammar (FF_FAULT_PLAN env var or --fault-plan, comma-separated):
 
@@ -48,6 +54,7 @@ SITES = (
     "fit/dispatch",
     "distributed/init",
     "pipe/boundary_hop",
+    "health/nonfinite",
 )
 
 
@@ -193,3 +200,28 @@ def check(site: str, index: Optional[int] = None) -> None:
     cls = PermanentInjectedFault if permanent else InjectedFault
     raise cls(f"injected fault at {site} (index {idx}"
               + (", permanent)" if permanent else ")"))
+
+
+def poison(site: str, index: Optional[int] = None) -> bool:
+    """Non-raising variant of check(): True when the armed fault for
+    `site` fires at `index`. Used by sites that model SILENT corruption
+    (health/nonfinite — the fit loop NaN-poisons the params and keeps
+    going so the numerics sentinel, not an exception, must catch it).
+    Emits the same fault/injected telemetry event as check()."""
+    if site not in SITES:
+        raise ValueError(f"unknown fault site {site!r}")
+    if not _SPECS:
+        return False
+    idx = next_index(site) if index is None else int(index)
+    with _LOCK:
+        for spec in _SPECS:
+            if spec.site == site and spec.should_fire(idx):
+                spec.fired += 1
+                _FIRED[site] = _FIRED.get(site, 0) + 1
+                permanent = spec.permanent
+                break
+        else:
+            return False
+    tel.event("fault/injected", cat="fault", site=site, index=idx,
+              permanent=permanent, poison=True)
+    return True

@@ -51,13 +51,42 @@ DRIFT_WARN_RATIO = 3.0
 class _Sink:
     """One open JSONL stream. All writes serialize under the module lock
     (spans are emitted from the fit loop, prefetch threads, and the async
-    checkpoint writer concurrently)."""
+    checkpoint writer concurrently).
 
-    def __init__(self, dir_: str):
+    Long elastic runs (days of fit + resume cycles) would grow a single
+    JSONL without bound, so the sink rotates by SIZE: once the current
+    segment exceeds `max_bytes` the next emit rolls to
+    `telemetry-<pid>.<seq>.jsonl`. Segments are never renamed or deleted
+    (concurrent readers — tools/monitor.py tailing the dir — stay valid),
+    and read_events() merges every `telemetry-*.jsonl` in the dir
+    ts-sorted, so trace_report / span_dataset / monitor see one stream."""
+
+    def __init__(self, dir_: str, max_bytes: Optional[int] = None):
         os.makedirs(dir_, exist_ok=True)
         self.dir = dir_
+        self.max_bytes = max_bytes
+        self._seq = 0
         self.path = os.path.join(dir_, f"telemetry-{os.getpid()}.jsonl")
         self._f = open(self.path, "a", buffering=1 << 16)
+        # appending to an existing stream (re-configure to the same dir in
+        # a new sink): count what's already there toward the size cap
+        try:
+            self._written = os.path.getsize(self.path)
+        except OSError:
+            self._written = 0
+
+    def _rotate_locked(self) -> None:
+        """Roll to the next segment (caller holds _LOCK)."""
+        try:
+            self._f.flush()
+            self._f.close()
+        except ValueError:
+            pass
+        self._seq += 1
+        self.path = os.path.join(
+            self.dir, f"telemetry-{os.getpid()}.{self._seq:03d}.jsonl")
+        self._f = open(self.path, "a", buffering=1 << 16)
+        self._written = 0
 
     def emit(self, obj: Dict[str, Any]) -> None:
         line = json.dumps(obj, separators=(",", ":"), default=str)
@@ -67,8 +96,13 @@ class _Sink:
             # the event is correct, raising into the caller is not (it
             # would mark a SUCCESSFUL checkpoint write as failed)
             try:
-                if not self._f.closed:
-                    self._f.write(line + "\n")
+                if self._f.closed:
+                    return
+                if (self.max_bytes is not None
+                        and self._written >= self.max_bytes):
+                    self._rotate_locked()
+                self._f.write(line + "\n")
+                self._written += len(line) + 1
             except ValueError:
                 pass
 
@@ -98,19 +132,26 @@ def _register_atexit() -> None:
     atexit.register(flush)
 
 
-def configure(telemetry_dir: Optional[str]) -> bool:
+def configure(telemetry_dir: Optional[str],
+              max_mb: Optional[float] = None) -> bool:
     """Enable (or re-point) the process-global sink. A falsy dir is a
     no-op — telemetry keeps its current state; turning it OFF is an
     explicit `shutdown()` (so one compile with --telemetry-dir doesn't get
-    silently disabled by a later compile without it). Returns enabled()."""
+    silently disabled by a later compile without it). `max_mb` caps each
+    JSONL segment's size (`--telemetry-max-mb`; None/0 = unbounded) — the
+    sink rotates to numbered segments past it. Returns enabled()."""
     global _SINK
     if not telemetry_dir:
         return _SINK is not None
     d = os.path.abspath(os.path.expanduser(telemetry_dir))
+    max_bytes = int(max_mb * (1 << 20)) if max_mb else None
     old = _SINK
     if old is not None and old.dir == d:
+        if max_mb is not None:
+            with _LOCK:
+                old.max_bytes = max_bytes
         return True
-    _SINK = _Sink(d)
+    _SINK = _Sink(d, max_bytes=max_bytes)
     if old is not None:
         old.close()
     _register_atexit()

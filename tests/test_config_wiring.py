@@ -163,6 +163,29 @@ def test_resilience_flags_wired(devices):
         assert flag in vf, flag
 
 
+def test_health_flags_wired():
+    """The ISSUE-9 health knobs flow parse_args -> FFConfig via
+    build_parser only (launcher value-flag set derives automatically):
+    sentinels default ON (BooleanOptionalAction), halt opt-in, and the
+    telemetry sink's size-based rotation cap generous by default."""
+    from flexflow_tpu.config import FFConfig as Cfg
+
+    cfg = Cfg.parse_args(["--telemetry-max-mb", "64",
+                          "--no-health-sentinels", "--halt-on-nonfinite"])
+    assert cfg.telemetry_max_mb == 64.0
+    assert cfg.health_sentinels is False
+    assert cfg.halt_on_nonfinite is True
+    d = Cfg()
+    assert d.telemetry_max_mb == 512.0  # generous: rotation rarely fires
+    assert d.health_sentinels is True   # zero-sync checks ride the defaults
+    assert d.halt_on_nonfinite is False  # halting is an explicit opt-in
+    assert Cfg.parse_args(["--health-sentinels"]).health_sentinels is True
+    # --telemetry-max-mb consumes a value token; the boolean gates don't
+    vf = Cfg.launcher_value_flags()
+    assert "--telemetry-max-mb" in vf
+    assert "--halt-on-nonfinite" not in vf
+
+
 def test_fault_plan_flag_arms_injector(devices):
     """--fault-plan reaches runtime/faults.py at compile time (the same
     hook order as --telemetry-dir): a bad plan fails loud at compile, a

@@ -82,6 +82,12 @@ FAMILIES: Dict[str, Tuple[str, Callable[[Dict[str, Any]],
         lambda d: [(k, float(d[k])) for k in
                    ("attributed_over_step", "coverage", "rows")
                    if d.get(k) is not None]),
+    "goodput": (
+        r"^BENCH_goodput\.json$",
+        lambda d: [(k, float(d[k])) for k in
+                   ("goodput_baseline", "goodput_ckpt_heavy",
+                    "accounted_frac_min")
+                   if d.get(k) is not None]),
 }
 
 

@@ -1,0 +1,307 @@
+#!/usr/bin/env python
+"""Live run-health monitor: tail a --telemetry-dir into a refreshing
+terminal dashboard — goodput bar + bucket breakdown (health/goodput
+events from flexflow_tpu/health.py), a step-time sparkline (fit/dispatch
+or pipe/update spans), numerics-sentinel status (health/nonfinite,
+health/grad_spike, health/loss_spike), HBM watermarks (health/hbm), and
+any fault/error events.
+
+Usage:
+    python tools/monitor.py <telemetry-dir> [--refresh 2.0] [--once]
+                            [--iterations N] [--prom-file node.prom]
+    python tools/monitor.py --check     # CI smoke: tiny fit -> dashboard
+
+--prom-file additionally writes a Prometheus textfile-collector export
+(atomic rename, so node_exporter never reads a torn file) on every
+refresh — the bridge from the local JSONL stream to a real alerting
+stack without running a server in the training process.
+
+The monitor is read-only and tail-safe: it re-reads the directory each
+refresh (telemetry.read_events merges rotated telemetry-*.jsonl segments
+and skips a crashed writer's torn tail), so it can watch a run that is
+still writing, already finished, or restarting under the elastic
+supervisor.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+SPARK = "▁▂▃▄▅▆▇█"
+STEP_SPAN_NAMES = ("fit/dispatch", "pipe/update")
+
+
+def load_events(path: str) -> List[Dict[str, Any]]:
+    from flexflow_tpu.telemetry import read_events
+
+    return read_events(path)
+
+
+# ------------------------------------------------------------------- gather
+def gather(events: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Fold the raw event stream into the dashboard's state dict (pure —
+    tests feed synthetic events)."""
+    goodputs: List[Dict[str, Any]] = []
+    steps_ms: List[float] = []
+    sent = {"nonfinite": 0, "grad_spike": 0, "loss_spike": 0}
+    last_nonfinite: Optional[Dict[str, Any]] = None
+    hbm: Dict[str, Dict[str, Any]] = {}
+    halts: List[Dict[str, Any]] = []
+    faults = 0
+    errors = 0
+    for ev in events:
+        name = ev.get("name", "")
+        args = ev.get("args") or {}
+        if name == "health/goodput":
+            goodputs.append(args)
+        elif name in STEP_SPAN_NAMES and ev.get("ph") == "X":
+            steps_ms.append(float(ev.get("dur", 0.0)) / 1e3)
+        elif name == "health/nonfinite":
+            sent["nonfinite"] += 1
+            last_nonfinite = args
+        elif name == "health/grad_spike":
+            sent["grad_spike"] += 1
+        elif name == "health/loss_spike":
+            sent["loss_spike"] += 1
+        elif name == "health/hbm":
+            hbm[str(args.get("tag", "?"))] = args
+        elif name == "health/halt":
+            halts.append(args)
+        elif name == "fault/injected":
+            faults += 1
+        if ev.get("cat") == "error":
+            errors += 1
+    return {"goodputs": goodputs, "steps_ms": steps_ms,
+            "sentinels": sent, "last_nonfinite": last_nonfinite,
+            "hbm": hbm, "halts": halts, "faults": faults,
+            "errors": errors, "events": len(events)}
+
+
+# ------------------------------------------------------------------- render
+def _bar(frac: float, width: int = 30) -> str:
+    frac = max(0.0, min(1.0, frac))
+    n = int(round(frac * width))
+    return "[" + "#" * n + "." * (width - n) + "]"
+
+
+def sparkline(values: List[float], width: int = 48) -> str:
+    vals = values[-width:]
+    if not vals:
+        return "(no steps yet)"
+    lo, hi = min(vals), max(vals)
+    span = (hi - lo) or 1.0
+    return "".join(SPARK[int((v - lo) / span * (len(SPARK) - 1))]
+                   for v in vals)
+
+
+def render(state: Dict[str, Any]) -> List[str]:
+    lines = [f"flexflow_tpu run monitor — {state['events']} events"]
+    gps = state["goodputs"]
+    if gps:
+        last = gps[-1]
+        gp = float(last.get("goodput", 0.0))
+        lines.append(f"goodput  {_bar(gp)} {100.0 * gp:5.1f}%  "
+                     f"(epoch {last.get('epoch')}, "
+                     f"wall {float(last.get('wall_s', 0.0)):.2f}s, "
+                     f"residual {float(last.get('residual_s', 0.0)):.3f}s)")
+        buckets = {k[:-2]: float(v) for k, v in last.items()
+                   if k.endswith("_s") and k not in
+                   ("wall_s", "residual_s")}
+        wall = float(last.get("wall_s", 0.0)) or 1e-12
+        parts = " ".join(f"{k}={100.0 * v / wall:.1f}%" for k, v in
+                         sorted(buckets.items(), key=lambda kv: -kv[1])
+                         if v > 0.0)
+        lines.append(f"buckets  {parts or '(none)'}")
+        if len(gps) > 1:
+            lines.append("epochs   " + " ".join(
+                f"{100.0 * float(g.get('goodput', 0.0)):.0f}%"
+                for g in gps[-12:]))
+    else:
+        lines.append("goodput  (no health/goodput events yet — epoch in "
+                     "progress or health disabled)")
+    steps = state["steps_ms"]
+    if steps:
+        tail = steps[-48:]
+        lines.append(f"steps    {sparkline(steps)}  "
+                     f"last={tail[-1]:.1f}ms "
+                     f"min={min(tail):.1f} max={max(tail):.1f} "
+                     f"(n={len(steps)})")
+    sent = state["sentinels"]
+    bad = sent["nonfinite"] or state["halts"]
+    status = "FATAL" if bad else (
+        "WARN" if sent["grad_spike"] or sent["loss_spike"] else "OK")
+    lines.append(f"numerics {status}: nonfinite={sent['nonfinite']} "
+                 f"grad_spikes={sent['grad_spike']} "
+                 f"loss_spikes={sent['loss_spike']}")
+    if state["last_nonfinite"]:
+        lines.append(f"         last nonfinite: {state['last_nonfinite']}")
+    for h in state["halts"][-2:]:
+        lines.append(f"         HALTED at step {h.get('step')}; recovery "
+                     f"checkpoint: {h.get('checkpoint') or '(none)'}")
+    mb = 1024 * 1024
+    for tag, s in list(state["hbm"].items())[-3:]:
+        lines.append(f"hbm      {tag}: peak "
+                     f"{float(s.get('peak_bytes', 0)) / mb:.2f}MB/device "
+                     f"live {float(s.get('live_bytes', 0)) / mb:.2f}MB "
+                     f"({s.get('devices')} devices)")
+    if state["faults"] or state["errors"]:
+        lines.append(f"faults   injected={state['faults']} "
+                     f"error_events={state['errors']}")
+    return lines
+
+
+# --------------------------------------------------------------- prometheus
+def prom_export(state: Dict[str, Any], path: str) -> None:
+    """Textfile-collector export: write gauges to <path> atomically."""
+    g: List[str] = []
+
+    def gauge(name: str, value: float, help_: str) -> None:
+        g.append(f"# HELP {name} {help_}")
+        g.append(f"# TYPE {name} gauge")
+        g.append(f"{name} {value:g}")
+
+    gps = state["goodputs"]
+    if gps:
+        last = gps[-1]
+        gauge("flexflow_goodput_ratio", float(last.get("goodput", 0.0)),
+              "Goodput fraction of the last closed epoch")
+        gauge("flexflow_goodput_residual_seconds",
+              float(last.get("residual_s", 0.0)),
+              "Unattributed wall-clock of the last closed epoch")
+        gauge("flexflow_epoch_wall_seconds",
+              float(last.get("wall_s", 0.0)),
+              "Wall-clock of the last closed epoch")
+    gauge("flexflow_epochs_total", float(len(gps)),
+          "Closed fit epochs observed in the telemetry stream")
+    if state["steps_ms"]:
+        gauge("flexflow_step_time_seconds",
+              state["steps_ms"][-1] / 1e3,
+              "Duration of the last observed step dispatch/update span")
+    sent = state["sentinels"]
+    gauge("flexflow_nonfinite_windows_total", float(sent["nonfinite"]),
+          "Sentinel windows with non-finite loss/grad")
+    gauge("flexflow_grad_spikes_total", float(sent["grad_spike"]),
+          "Grad-norm spike warnings")
+    gauge("flexflow_loss_spikes_total", float(sent["loss_spike"]),
+          "Loss spike warnings")
+    gauge("flexflow_run_halts_total", float(len(state["halts"])),
+          "Fatal health halts (health/halt events)")
+    peak = max((float(s.get("peak_bytes", 0))
+                for s in state["hbm"].values()), default=0.0)
+    gauge("flexflow_hbm_peak_bytes", peak,
+          "Max per-device peak memory across watermark samples")
+    gauge("flexflow_error_events_total", float(state["errors"]),
+          "Events in the reserved error category")
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write("\n".join(g) + "\n")
+    os.replace(tmp, path)
+
+
+# --------------------------------------------------------------------- main
+def run_once(telemetry_dir: str, prom_file: Optional[str] = None,
+             clear: bool = False) -> Dict[str, Any]:
+    state = gather(load_events(telemetry_dir))
+    out = render(state)
+    if clear:
+        sys.stdout.write("\x1b[2J\x1b[H")
+    print("\n".join(out))
+    if prom_file:
+        prom_export(state, prom_file)
+    return state
+
+
+def _check() -> int:
+    """CI smoke: tiny CPU fit with telemetry -> gather/render/prom."""
+    import tempfile
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np
+
+    from flexflow_tpu import FFConfig, FFModel, SGDOptimizer
+    from flexflow_tpu.losses import LossType
+
+    with tempfile.TemporaryDirectory() as td:
+        tdir = os.path.join(td, "tel")
+        cfg = FFConfig(batch_size=8, epochs=2, seed=0,
+                       telemetry_dir=tdir, log_level="warning")
+        m = FFModel(cfg)
+        t = m.create_tensor([8, 16], name="x")
+        m.dense(t, 4, name="head")
+        cm = m.compile(SGDOptimizer(lr=0.05),
+                       LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+                       metrics=[])
+        cm.init(seed=0)
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(32, 16)).astype(np.float32)
+        y = rng.integers(0, 4, size=(32,)).astype(np.int32)
+        cm.fit(x, y, epochs=2, verbose=False)
+        from flexflow_tpu import telemetry as tel
+
+        tel.shutdown()
+        prom = os.path.join(td, "flexflow.prom")
+        state = run_once(tdir, prom_file=prom)
+        ok = (len(state["goodputs"]) == 2
+              and state["sentinels"]["nonfinite"] == 0
+              and os.path.exists(prom))
+        if ok:
+            with open(prom) as f:
+                ok = "flexflow_goodput_ratio" in f.read()
+    print("CHECK " + ("PASS" if ok else "FAIL"))
+    return 0 if ok else 1
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("telemetry_dir", nargs="?",
+                    help="telemetry dir (or one .jsonl file) to tail")
+    ap.add_argument("--refresh", type=float, default=2.0,
+                    help="seconds between dashboard refreshes")
+    ap.add_argument("--once", action="store_true",
+                    help="render one frame and exit (no screen clearing)")
+    ap.add_argument("--iterations", type=int, default=0,
+                    help="stop after N refreshes (0 = until Ctrl-C)")
+    ap.add_argument("--prom-file", default=None,
+                    help="write a Prometheus textfile export here on "
+                    "every refresh")
+    ap.add_argument("--json", action="store_true",
+                    help="with --once: dump the gathered state as JSON "
+                    "instead of the dashboard")
+    ap.add_argument("--check", action="store_true",
+                    help="CI smoke: tiny fit -> dashboard -> verify")
+    args = ap.parse_args(argv)
+    if args.check:
+        return _check()
+    if not args.telemetry_dir:
+        ap.error("telemetry_dir is required (or --check)")
+    if args.once:
+        if args.json:
+            state = gather(load_events(args.telemetry_dir))
+            if args.prom_file:
+                prom_export(state, args.prom_file)
+            print(json.dumps(state, indent=2, default=str))
+        else:
+            run_once(args.telemetry_dir, args.prom_file)
+        return 0
+    n = 0
+    try:
+        while True:
+            run_once(args.telemetry_dir, args.prom_file, clear=True)
+            n += 1
+            if args.iterations and n >= args.iterations:
+                break
+            time.sleep(max(0.1, args.refresh))
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
