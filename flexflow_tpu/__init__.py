@@ -47,6 +47,17 @@ def get_launch_config() -> "FFConfig":
     config when the script runs standalone."""
     return _launch_config if _launch_config is not None else FFConfig()
 
+
+def __getattr__(name):
+    # lazy: the serving subsystem pulls in the whole compiler stack, which
+    # plain `import flexflow_tpu` (launcher, tests) shouldn't pay for
+    if name == "compile_serving":
+        from flexflow_tpu.serving.engine import compile_serving
+
+        return compile_serving
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
 __all__ = [
     "DataType",
     "FFConfig",
@@ -58,4 +69,5 @@ __all__ = [
     "LossType",
     "MetricsType",
     "OperatorType",
+    "compile_serving",
 ]

@@ -132,19 +132,36 @@ def compile_model(model, optimizer, loss_type: LossType, metrics: Sequence[Metri
         return _compile_model(model, optimizer, loss_type, metrics, outputs)
 
 
-def _compile_model(model, optimizer, loss_type, metrics, outputs):
-    cfg = model.config
+def compile_serving(model, **kwargs):
+    """Serving twin of `compile_model` (flexflow_tpu/serving/engine.py):
+    lowers the graph twice — compute-priced prefill and bandwidth-priced
+    single-token decode — searches a strategy per program, and returns a
+    ServingCompiled over a paged KV cache. Lazy import: serving builds on
+    this module (build_init_fn / resolve_machine / _overlay_parallel_ops)."""
+    from flexflow_tpu.serving.engine import compile_serving as _compile_serving
+
+    return _compile_serving(model, **kwargs)
+
+
+def resolve_machine(cfg) -> MachineSpec:
+    """The machine description every compile entry point shares (training
+    `compile_model` and the serving `compile_serving`): an explicit machine
+    file wins, then the --nodes DCN description, then mesh-shape detection."""
     if cfg.machine_model_file:
-        machine = MachineSpec.from_file(cfg.machine_model_file)
-    elif not cfg.mesh_shape and cfg.num_nodes > 1:
+        return MachineSpec.from_file(cfg.machine_model_file)
+    if not cfg.mesh_shape and cfg.num_nodes > 1:
         # --nodes/-ll:tpu (reference machine description): nodes form a
         # DCN-crossing axis, per-node workers the intra-node data axis
         workers = cfg.workers_per_node or max(
             1, len(jax.devices()) // cfg.num_nodes)
-        machine = MachineSpec.detect({"node": cfg.num_nodes, "data": workers},
-                                     dcn_axes=("node",))
-    else:
-        machine = MachineSpec.detect(cfg.mesh_shape)
+        return MachineSpec.detect({"node": cfg.num_nodes, "data": workers},
+                                  dcn_axes=("node",))
+    return MachineSpec.detect(cfg.mesh_shape)
+
+
+def _compile_model(model, optimizer, loss_type, metrics, outputs):
+    cfg = model.config
+    machine = resolve_machine(cfg)
     level = getattr(logging, cfg.log_level.upper(), None)
     if level is None:
         raise ValueError(f"unknown log_level {cfg.log_level!r}")

@@ -175,6 +175,23 @@ class FFConfig:
     # analog of the reference simulator's export_file_name
     simulator_trace: str = ""
     log_level: str = "info"
+    # inference serving (flexflow_tpu/serving): compile_serving() lowers the
+    # graph twice — a compute-priced prefill program and a bandwidth-priced
+    # single-token decode program, each with its own searched strategy — and
+    # serves them through a paged KV cache + continuous-batching scheduler.
+    #   serve            — gate: launcher builds the serving engine instead
+    #                      of the training executable
+    #   max_decode_len   — per-request decode budget (0 = serving default)
+    #   kv_page_size     — tokens per KV-cache page
+    #   max_batch_slots  — concurrent decode slots (the decode batch dim)
+    #   serve_objective  — _score objective for the serving searches:
+    #                      "latency" (pure time) or "throughput" (time
+    #                      discounted by memory headroom for bigger batches)
+    serve: bool = False
+    max_decode_len: int = 0
+    kv_page_size: int = 16
+    max_batch_slots: int = 8
+    serve_objective: str = "latency"
 
     @property
     def total_devices(self) -> int:
@@ -263,6 +280,12 @@ class FFConfig:
         p.add_argument("--remat", action="store_true")
         p.add_argument("--compgraph", dest="export_dot", type=str, default="")
         p.add_argument("--include-costs-dot-graph", action="store_true")
+        p.add_argument("--serve", action="store_true")
+        p.add_argument("--max-decode-len", type=int, default=0)
+        p.add_argument("--kv-page-size", type=int, default=16)
+        p.add_argument("--max-batch-slots", type=int, default=8)
+        p.add_argument("--serve-objective", type=str, default="latency",
+                       choices=("latency", "throughput"))
         return p
 
     @staticmethod
@@ -359,4 +382,9 @@ class FFConfig:
             remat=args.remat,
             export_dot=args.export_dot,
             include_costs_dot_graph=args.include_costs_dot_graph,
+            serve=args.serve,
+            max_decode_len=args.max_decode_len,
+            kv_page_size=args.kv_page_size,
+            max_batch_slots=args.max_batch_slots,
+            serve_objective=args.serve_objective,
         )

@@ -128,12 +128,18 @@ class FFModel:
                             embed_dim: int, num_heads: int, kdim: int = 0, vdim: int = 0,
                             dropout: float = 0.0, bias: bool = True, add_bias_kv: bool = False,
                             add_zero_attn: bool = False, causal: bool = False,
-                            kernel_initializer=None, impl: str = "auto", name=None) -> Tensor:
+                            kernel_initializer=None, impl: str = "auto",
+                            decode: bool = False, kv_out: bool = False,
+                            name=None) -> Tensor:
+        # decode: single-token serving step reading/writing the paged KV
+        # cache via lowering state; kv_out: prefill variant that exposes
+        # per-head K/V for cache commit (flexflow_tpu/serving)
         return self._add_layer(
             OperatorType.MULTIHEAD_ATTENTION,
             {"embed_dim": int(embed_dim), "num_heads": int(num_heads), "kdim": kdim,
              "vdim": vdim, "dropout": dropout, "bias": bias, "add_bias_kv": add_bias_kv,
-             "add_zero_attn": add_zero_attn, "causal": causal, "impl": impl},
+             "add_zero_attn": add_zero_attn, "causal": causal, "impl": impl,
+             "decode": decode, "kv_out": kv_out},
             [query, key, value], name,
             {"wq": kernel_initializer, "wk": kernel_initializer, "wv": kernel_initializer,
              "wo": kernel_initializer})[0]

@@ -67,10 +67,12 @@ class GPT2Config:
                 + 2 * d + d * self.vocab)  # ln_f + lm_head
 
 
-def gpt2_block(model: FFModel, t, cfg: GPT2Config, name: str):
+def gpt2_block(model: FFModel, t, cfg: GPT2Config, name: str,
+               decode: bool = False):
     h = model.layer_norm(t, name=f"{name}_ln1")
     att = model.multihead_attention(h, h, h, cfg.d_model, cfg.heads,
-                                    dropout=cfg.dropout, causal=True,
+                                    dropout=0.0 if decode else cfg.dropout,
+                                    causal=True, decode=decode,
                                     name=f"{name}_attn")
     t = model.add(att, t, name=f"{name}_res1")
     h = model.layer_norm(t, name=f"{name}_ln2")
@@ -79,16 +81,23 @@ def gpt2_block(model: FFModel, t, cfg: GPT2Config, name: str):
     return model.add(down, t, name=f"{name}_res2")
 
 
-def build_gpt2(model: FFModel, cfg: GPT2Config, batch: int = 8):
-    ids = model.create_tensor([batch, cfg.seq], DataType.INT32, name="input_ids")
-    pos = model.create_tensor([batch, cfg.seq], DataType.INT32, name="position_ids")
+def build_gpt2(model: FFModel, cfg: GPT2Config, batch: int = 8,
+               decode: bool = False):
+    """decode=True builds the single-token serving twin: ids/pos are
+    [batch, 1], every attention reads/writes the paged KV cache through
+    lowering state (flexflow_tpu/serving), and dropout is inert. Layer
+    names, weight specs, and topo order match the training build exactly,
+    so params transfer 1:1 and build_init_fn produces identical init."""
+    seq = 1 if decode else cfg.seq
+    ids = model.create_tensor([batch, seq], DataType.INT32, name="input_ids")
+    pos = model.create_tensor([batch, seq], DataType.INT32, name="position_ids")
     tok = model.embedding(ids, cfg.vocab, cfg.d_model, name="wte")
     pe = model.embedding(pos, cfg.seq, cfg.d_model, name="wpe")
     t = model.add(tok, pe, name="embed_add")
     if cfg.dropout:
-        t = model.dropout(t, cfg.dropout, name="embed_drop")
+        t = model.dropout(t, 0.0 if decode else cfg.dropout, name="embed_drop")
     for i in range(cfg.layers):
-        t = gpt2_block(model, t, cfg, f"h{i}")
+        t = gpt2_block(model, t, cfg, f"h{i}", decode=decode)
     t = model.layer_norm(t, name="ln_f")
     out_v = cfg.vocab
     if cfg.vocab_pad_to:

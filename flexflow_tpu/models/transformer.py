@@ -19,14 +19,17 @@ def transformer_block(model: FFModel, t, d_model: int, heads: int, d_ff: int,
 
 def build_transformer(model: FFModel, batch: int = 8, seq: int = 512,
                       d_model: int = 512, heads: int = 8, d_ff: int = 2048,
-                      layers: int = 6, classes: int = 0):
+                      layers: int = 6, classes: int = 0,
+                      causal: bool = False, dropout: float = 0.1):
     """The reference example feeds raw (batch, seq, d_model) activations
     (transformer.cc creates the input tensor directly); classes>0 appends an
-    LM head."""
+    LM head. causal=True builds the decoder variant the serving stack can
+    run incrementally against a KV cache."""
     x = model.create_tensor([batch, seq, d_model], name="x")
     t = x
     for i in range(layers):
-        t = transformer_block(model, t, d_model, heads, d_ff, f"blk{i}")
+        t = transformer_block(model, t, d_model, heads, d_ff, f"blk{i}",
+                              dropout=dropout, causal=causal)
     if classes:
         t = model.dense(t, classes, name="lm_head")
     return x, t

@@ -66,9 +66,77 @@ def _split_heads(x, heads):
     return x.reshape(b, s, heads, e // heads)
 
 
+def _mha_decode_lower(layer: Layer, inputs, weights, ctx: LoweringCtx):
+    """Single-token decode step against the paged KV cache (serving path).
+
+    Inputs are [slots, 1, embed]; the cache lives in lowering state:
+      ctx.state[layer.name]    = {"k": [pages, page, h, d], "v": ...}
+      ctx.state["serve/page_table"] = [slots, pages_per_slot] int32 page ids
+      ctx.state["serve/pos"]        = [slots] int32 count of cached tokens
+
+    The new token's K/V is scattered into page pos//page_size at offset
+    pos%page_size, then attention runs over the gathered per-slot pages with
+    a per-slot length mask (positions <= pos). Inactive slots point every
+    page-table entry at the reserved scratch page 0 with pos 0, so their
+    writes land in scratch and their (garbage but finite) outputs are
+    ignored by the scheduler. Everything is a fixed-shape gather/scatter —
+    no resharding, no recompilation across steps."""
+    q = inputs[0]
+    p = layer.params
+    heads = p["num_heads"]
+    embed = p["embed_dim"]
+    dt = q.dtype
+
+    def proj(x, w, b):
+        y = x @ weights[w].astype(dt)
+        if b in weights:
+            y = y + weights[b].astype(dt)
+        return y
+
+    qh = _split_heads(proj(inputs[0], "wq", "bq"), heads)  # (slots, 1, h, d)
+    kh = _split_heads(proj(inputs[1], "wk", "bk"), heads)
+    vh = _split_heads(proj(inputs[2], "wv", "bv"), heads)
+
+    cache = ctx.state[layer.name]
+    k_pool, v_pool = cache["k"], cache["v"]
+    pt = ctx.state["serve/page_table"]
+    pos = ctx.state["serve/pos"]
+    page = k_pool.shape[1]
+    b = q.shape[0]
+    rows = jnp.arange(b)
+    pidx = pt[rows, pos // page]
+    off = pos % page
+    k_pool = k_pool.at[pidx, off].set(kh[:, 0].astype(k_pool.dtype))
+    v_pool = v_pool.at[pidx, off].set(vh[:, 0].astype(v_pool.dtype))
+    ctx.new_state[layer.name] = {"k": k_pool, "v": v_pool}
+
+    # gather each slot's pages: [slots, pages_per_slot, page, h, d]
+    K = k_pool[pt].reshape(b, -1, heads, embed // heads).astype(dt)
+    V = v_pool[pt].reshape(b, -1, heads, embed // heads).astype(dt)
+    scale = 1.0 / math.sqrt(embed // heads)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", qh, K) * scale
+    # causal-by-construction: attend cached positions 0..pos (inclusive —
+    # position pos is the token just written)
+    keep = jnp.arange(K.shape[1])[None, None, None, :] <= pos[:, None, None, None]
+    logits = jnp.where(keep, logits, jnp.finfo(logits.dtype).min)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, V).reshape(b, 1, embed)
+    y = out @ weights["wo"].astype(dt)
+    if "bo" in weights:
+        y = y + weights["bo"].astype(dt)
+    return [y]
+
+
 def _mha_lower(layer: Layer, inputs, weights, ctx: LoweringCtx):
     q, k, v = inputs[:3]
     p = layer.params
+    if p.get("decode", False) or p.get("kv_out", False):
+        if p.get("add_bias_kv", False) or p.get("add_zero_attn", False):
+            raise NotImplementedError(
+                "KV-cache decode/prefill does not support add_bias_kv/"
+                "add_zero_attn (extra key positions would enter the cache)")
+    if p.get("decode", False):
+        return _mha_decode_lower(layer, inputs, weights, ctx)
     heads = p["num_heads"]
     embed = p["embed_dim"]
     dt = q.dtype
@@ -81,6 +149,12 @@ def _mha_lower(layer: Layer, inputs, weights, ctx: LoweringCtx):
 
     kp = proj(k, "wk", "bk")
     vp = proj(v, "wv", "bv")
+    if p.get("kv_out", False):
+        # serving prefill: expose the per-head K/V of the prompt tokens so
+        # the engine can commit them into the paged cache (captured BEFORE
+        # any bias_kv/zero_attn positions could pollute the cache)
+        ctx.new_state[layer.name] = {"k": _split_heads(kp, heads),
+                                     "v": _split_heads(vp, heads)}
     if "bias_k" in weights:  # add_bias_kv: learned extra kv position
         b_ = k.shape[0]
         kp = jnp.concatenate([kp, jnp.broadcast_to(weights["bias_k"].astype(dt), (b_, 1, embed))], axis=1)

@@ -65,6 +65,61 @@ def opt_mem_spec(optimizer, cfg, machine: MachineSpec) -> Optional[OptMemSpec]:
                       zero_axes=zero_axes)
 
 
+@dataclasses.dataclass(frozen=True)
+class KVCacheSpec:
+    """Paged KV-cache geometry for the serving (decode) search — the memory
+    term that does NOT exist at training time. The decode program holds, per
+    attention layer, a key pool and a value pool of `slots * pages_per_slot`
+    fixed-size pages (+ one scratch page inactive slots write into), each
+    page holding `page_size` token positions of (heads, head_dim) vectors.
+    The pools are sharded over the heads dim along the model axis the decode
+    strategy picked for the attention weights, so `per_device_bytes` divides
+    by that degree. The serving search subtracts this from the HBM cap
+    (compile_serving) and the runtime reports it in memory_stats() next to
+    the measured watermark."""
+
+    layers: int          # attention layers holding a cache
+    heads: int
+    head_dim: int
+    slots: int           # concurrent decode slots (max_batch_slots)
+    pages_per_slot: int
+    page_size: int       # token positions per page
+    itemsize: int = 4
+
+    @property
+    def padded_len(self) -> int:
+        """Max cached positions per sequence (page-rounded)."""
+        return self.pages_per_slot * self.page_size
+
+    @property
+    def pool_pages(self) -> int:
+        """Pages in one pool: every slot's worth plus the scratch page."""
+        return self.slots * self.pages_per_slot + 1
+
+    def layer_bytes(self) -> int:
+        """K + V pool bytes for ONE attention layer (unsharded)."""
+        return (2 * self.pool_pages * self.page_size * self.heads
+                * self.head_dim * self.itemsize)
+
+    def total_bytes(self) -> int:
+        return self.layers * self.layer_bytes()
+
+    def per_device_bytes(self, model_degree: int = 1) -> int:
+        """Resident bytes per device with the heads dim sharded
+        `model_degree` ways (1 = replicated pools)."""
+        return self.total_bytes() // max(1, model_degree)
+
+    def step_read_bytes(self, model_degree: int = 1) -> int:
+        """HBM traffic ONE decode step adds per device: the full live K/V
+        working set streams through the attention — the bandwidth term the
+        decode cost_fn charges on top of the weight streaming."""
+        return self.total_bytes() // max(1, model_degree)
+
+    def fingerprint(self) -> tuple:
+        return (self.layers, self.heads, self.head_dim, self.slots,
+                self.pages_per_slot, self.page_size, self.itemsize)
+
+
 def zero_divisor(spec: TensorSpec, dims: Sequence[DimSharding],
                  machine: MachineSpec, zero_axes: Sequence[str]) -> int:
     """Degree the ZeRO runtime actually divides this weight's moments by.

@@ -59,14 +59,26 @@ def _drop_axis(d, ax):
     return d
 
 
-def _score(cost: float, mem: int, mem_budget: float) -> float:
+def _score(cost: float, mem: int, mem_budget: float,
+           objective: str = "latency") -> float:
     """Cost scaled by a quadratic over-HBM penalty (memory-aware lambda
     analog). Multiplicative so the penalty has the same units as the cost;
-    the small floor keeps the penalty alive even at zero accumulated cost."""
-    if mem <= mem_budget:
-        return cost
-    over = (mem - mem_budget) / mem_budget
-    return (cost + 1e-9) * (1.0 + 10.0 * over * over)
+    the small floor keeps the penalty alive even at zero accumulated cost.
+
+    `objective` is the serving-search knob (--serve-objective):
+      "latency"    — rank by time alone under the budget (training default,
+                     and the decode-latency regime).
+      "throughput" — under the budget, memory is not free: every byte a
+                     strategy holds is a byte the KV cache can't turn into
+                     concurrent sequences, so the score carries a mild
+                     linear memory-pressure term. Over budget both
+                     objectives fall off the same quadratic cliff."""
+    if mem > mem_budget:
+        over = (mem - mem_budget) / mem_budget
+        return (cost + 1e-9) * (1.0 + 10.0 * over * over)
+    if objective == "throughput":
+        return cost * (1.0 + 0.25 * mem / mem_budget)
+    return cost
 
 
 @dataclasses.dataclass
@@ -183,9 +195,19 @@ def _search_graph_impl(model, machine: MachineSpec, beam_width: int = 64,
                  topk: int = 1,
                  prefix_cache: Optional[DPPrefixCache] = None,
                  opt_mem: "Optional[cm.OptMemSpec]" = None,
+                 objective: str = "latency",
+                 inference: bool = False,
                  ) -> "SearchResult | List[SearchResult]":
     """cost_fn(layer, cand) -> seconds overrides the analytic op time
     (hook for the measured path, search/measure.py).
+
+    `objective` ("latency" | "throughput") selects the _score variant the
+    beam ranks by — the serving search's latency-vs-throughput knob.
+    `inference` drops the training-only cost terms: no gradient all-reduce
+    on the op edges and no backward-pass copy in the live-activation
+    accounting (forward values only) — a serving program never holds
+    grads, so pricing them would bias the decode search toward
+    weight-sharded layouts for the wrong reason.
 
     `opt_mem` (cost_model.OptMemSpec) is the optimizer's memory model:
     moments counted and sized by the optimizer's actual state_dtype, and
@@ -237,10 +259,16 @@ def _search_graph_impl(model, machine: MachineSpec, beam_width: int = 64,
         for t in model.input_tensors))
     specs = {t.guid: t.spec for t in model.input_tensors}
 
+    # inference holds no backward copies: forward value only (1x vs 2x)
+    act_mult = 1 if inference else 2
+
     def _live_act_bytes(frontier_map) -> int:
         # 2x: forward value + gradient held for the backward pass
-        return sum(2 * cm.shard_bytes(specs[g], list(d), machine)
+        return sum(act_mult * cm.shard_bytes(specs[g], list(d), machine)
                    for g, d in frontier_map.items())
+
+    def score(c: float, m: int) -> float:
+        return _score(c, m, mem_budget, objective)
 
     # beam entries: frontier -> (cost, w_mem, act_high, trace)
     # w_mem = cumulative persistent weight memory (params+grads+opt moments:
@@ -345,10 +373,12 @@ def _search_graph_impl(model, machine: MachineSpec, beam_width: int = 64,
                     # consumer's pure compute. Purely additive costing
                     # (overlap_frac=0) systematically over-prices strategies
                     # whose collectives ride behind the next op's matmuls.
-                    op_comm = cand.extra_comm + cm.grad_sync_time(
-                        layer.weight_specs, cand.weight_dims, machine,
-                        _batch_axes_cached,
-                        zero=bool(opt_mem and opt_mem.zero_axes))
+                    op_comm = cand.extra_comm
+                    if not inference:
+                        op_comm += cm.grad_sync_time(
+                            layer.weight_specs, cand.weight_dims, machine,
+                            _batch_axes_cached,
+                            zero=bool(opt_mem and opt_mem.zero_axes))
                     comp = max(0.0, total - op_comm)
                     c += cm.overlapped_step_cost(comp, edge_comm + op_comm,
                                                  machine)
@@ -370,14 +400,14 @@ def _search_graph_impl(model, machine: MachineSpec, beam_width: int = 64,
                         nf[o.guid] = out_dims[o.guid]
                 key = tuple(sorted(nf.items()))
                 prev = new_beam.get(key)
-                if prev is None or _score(c, wm + ah, mem_budget) < _score(
-                        prev[0], prev[1] + prev[2], mem_budget):
+                if prev is None or score(c, wm + ah) < score(
+                        prev[0], prev[1] + prev[2]):
                     new_beam[key] = (c, wm, ah, trace + (ci,))
         # beam prune (ranked by cost + memory penalty; wm+ah understates the
         # final high-water by weights not yet placed, uniformly across states)
         if len(new_beam) > beam_width:
             ranked = sorted(new_beam.items(),
-                            key=lambda kv: _score(kv[1][0], kv[1][1] + kv[1][2], mem_budget))
+                            key=lambda kv: score(kv[1][0], kv[1][1] + kv[1][2]))
             new_beam = dict(ranked[:beam_width])
         beam = new_beam
         if not beam:
@@ -402,7 +432,7 @@ def _search_graph_impl(model, machine: MachineSpec, beam_width: int = 64,
             cost=cost, mem_bytes=wm + ah)
 
     ranked = sorted(beam.values(),
-                    key=lambda v: _score(v[0], v[1] + v[2], mem_budget))
+                    key=lambda v: score(v[0], v[1] + v[2]))
     if topk > 1:
         # distinct finalists for the event-driven re-rank (search/simulator
         # .py): the final beam holds the best trace per terminal frontier

@@ -1,0 +1,280 @@
+"""compile_serving — two searched programs + a paged cache per model.
+
+`compile_serving(model)` is the serving counterpart of `compile_model`:
+it replays the training graph into a prefill twin (`[slots, S]`, attention
+exposing per-head K/V) and a decode twin (`[slots, 1]`, attention
+reading/writing the paged KV cache), runs the frontier DP on EACH under
+serving pricing (serving/program.py — compute-priced prefill, bandwidth-
+priced decode with the KV working set in both the cost and the memory
+cap), and returns a `ServingCompiled` holding both jitted programs, the
+`PagedKVCache` laid out by the winning decode strategy, and the memory/
+watermark accounting the health layer checks.
+
+Determinism is a hard default here, not a caller flag: both programs are
+traced with training=False and a FIXED rng, and every dropout in the
+clones is rate-0 — two runs of the same requests produce bitwise-identical
+logits (the inference-determinism satellite of ISSUE 10).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec
+
+from flexflow_tpu import health
+from flexflow_tpu import telemetry as tel
+from flexflow_tpu.compiler.compile import (build_init_fn, resolve_machine,
+                                           _overlay_parallel_ops)
+from flexflow_tpu.compiler.lowering import build_forward, constrainable
+from flexflow_tpu.core.graph import topo_order
+from flexflow_tpu.ops.op_type import OperatorType
+from flexflow_tpu.parallel.default_strategy import data_parallel_strategy
+from flexflow_tpu.parallel.machine import MachineSpec, build_mesh
+from flexflow_tpu.search import cost_model as cm
+from flexflow_tpu.serving.kv_cache import (ACTIVE_KEY, POS_KEY, PagedKVCache)
+from flexflow_tpu.serving.program import (attn_head_degree, clone_for_serving,
+                                          serving_optimize)
+
+log = logging.getLogger("flexflow_tpu")
+
+
+def _wq_heads_axis(strategy, attn_layers):
+    """The mesh axis (or axis tuple) the decode strategy put on the
+    attention heads — dim 1 of wq. The KV pools shard their heads dim on
+    the same axis so cache reads/writes never reshard."""
+    for name in attn_layers:
+        sh = strategy.op_shardings.get(name)
+        dims = sh.weights.get("wq") if sh is not None else None
+        if dims and len(dims) > 1 and dims[1] is not None:
+            d = dims[1]
+            return tuple(d) if isinstance(d, list) else d
+    return None
+
+
+def compile_serving(model, max_batch_slots: Optional[int] = None,
+                    max_decode_len: Optional[int] = None,
+                    kv_page_size: Optional[int] = None) -> "ServingCompiled":
+    """Build the serving programs for a decoder `model` (inputs shaped
+    `[batch, seq, ...]`). Knob precedence: explicit args > FFConfig flags
+    (--max-batch-slots / --max-decode-len / --kv-page-size) > defaults."""
+    cfg = model.config
+    slots = int(max_batch_slots or getattr(cfg, "max_batch_slots", 8) or 8)
+    max_new = int(max_decode_len or getattr(cfg, "max_decode_len", 0) or 32)
+    page = int(kv_page_size or getattr(cfg, "kv_page_size", 16) or 16)
+    attn_params = [l.params for l in model.layers
+                   if l.op_type is OperatorType.MULTIHEAD_ATTENTION]
+    if not attn_params:
+        raise ValueError("compile_serving needs a model with attention "
+                         "layers (nothing to cache)")
+    heads = int(attn_params[0]["num_heads"])
+    embed = int(attn_params[0]["embed_dim"])
+    seq = int(model.input_tensors[0].spec.shape[1])
+    with tel.span("serve/compile_serving", cat="compile", slots=slots,
+                  max_decode_len=max_new, kv_page_size=page):
+        machine = resolve_machine(cfg)
+        mesh = build_mesh(machine)
+        pre_model, attn = clone_for_serving(model, "prefill", slots)
+        dec_model, _ = clone_for_serving(model, "decode", slots)
+        kv_spec = cm.KVCacheSpec(
+            layers=len(attn), heads=heads, head_dim=embed // heads,
+            slots=slots, pages_per_slot=-(-(seq + max_new) // page),
+            page_size=page, itemsize=4)
+        searched = (getattr(cfg, "search_budget", 0) > 0
+                    and not cfg.only_data_parallel
+                    and machine.num_devices > 1)
+        if searched:
+            pre_st = serving_optimize(pre_model, machine, "prefill", attn)
+            dec_st = serving_optimize(dec_model, machine, "decode", attn,
+                                      kv_spec)
+        else:
+            pre_st = data_parallel_strategy(pre_model, machine)
+            dec_st = data_parallel_strategy(dec_model, machine)
+        _overlay_parallel_ops(pre_model, pre_st)
+        _overlay_parallel_ops(dec_model, dec_st)
+        log.info("compile_serving: mesh=%s slots=%d kv=%d pages x %d tok "
+                 "(%.1f MiB/device)", dict(machine.mesh_axes), slots,
+                 kv_spec.pool_pages, page,
+                 kv_spec.per_device_bytes(
+                     attn_head_degree(dec_st, attn, machine)) / 2**20)
+        return ServingCompiled(model, machine, mesh, pre_model, dec_model,
+                               pre_st, dec_st, attn, kv_spec, max_new)
+
+
+class ServingCompiled:
+    """The two jitted serving programs + the paged cache they share."""
+
+    def __init__(self, model, machine: MachineSpec, mesh, prefill_model,
+                 decode_model, prefill_strategy, decode_strategy,
+                 attn_layers: List[str], kv_spec: "cm.KVCacheSpec",
+                 max_decode_len: int):
+        self.model = model
+        self.cfg = model.config
+        self.machine = machine
+        self.mesh = mesh
+        self.prefill_model = prefill_model
+        self.decode_model = decode_model
+        self.prefill_strategy = prefill_strategy
+        self.decode_strategy = decode_strategy
+        self.attn_layers = list(attn_layers)
+        self.kv_spec = kv_spec
+        self.max_decode_len = int(max_decode_len)
+        self.slots = int(kv_spec.slots)
+        self._watermarks = health.WatermarkTracker()
+
+        cdt = self.cfg.compute_dtype
+        pool_dtype = jnp.dtype(cdt) if cdt and cdt not in ("float32", "f32") \
+            else jnp.float32
+        heads_axis = _wq_heads_axis(decode_strategy, self.attn_layers)
+        self.kv = PagedKVCache(kv_spec, self.attn_layers, mesh,
+                               heads_axis=heads_axis, dtype=pool_dtype)
+        deg = 1
+        if self.kv.heads_axis is not None:
+            axes = (self.kv.heads_axis,) if isinstance(self.kv.heads_axis, str) \
+                else tuple(self.kv.heads_axis)
+            for a in axes:
+                deg *= mesh.shape.get(a, 1)
+        self.kv_shard_degree = deg
+
+        pre_out = prefill_model.layers[-1].outputs[:1]
+        dec_out = decode_model.layers[-1].outputs[:1]
+        pre_fwd = build_forward(prefill_model.layers,
+                                prefill_model.input_tensors, pre_out, mesh,
+                                prefill_strategy,
+                                seq_length=self.cfg.seq_length or None,
+                                compute_dtype=self.cfg.compute_dtype,
+                                enable_fusion=self.cfg.enable_fusion)
+        dec_fwd = build_forward(decode_model.layers,
+                                decode_model.input_tensors, dec_out, mesh,
+                                decode_strategy,
+                                seq_length=self.cfg.seq_length or None,
+                                compute_dtype=self.cfg.compute_dtype,
+                                enable_fusion=self.cfg.enable_fusion)
+        rng0 = jax.random.PRNGKey(0)  # deterministic-mode hard default
+
+        def _prefill(params, inputs):
+            outs, kv_state = pre_fwd(params, {}, inputs, False, rng0)
+            return outs[0], kv_state
+
+        def _decode(params, state, inputs):
+            outs, ns = dec_fwd(params, state, inputs, False, rng0)
+            # device-side sequence advance: every ACTIVE slot cached one
+            # more token this step (inactive slots stay parked), so the
+            # bounded dispatch-ahead loop never syncs to bump positions
+            ns[POS_KEY] = state[POS_KEY] + state[ACTIVE_KEY].astype(
+                state[POS_KEY].dtype)
+            return outs[0], ns
+
+        self._prefill_jit = jax.jit(_prefill)
+        self._decode_jit = jax.jit(_decode)
+        self.params: Optional[Dict[str, Any]] = None
+
+    # ------------------------------------------------------------- weights
+    def _weight_sharding(self, layer_name: str, wname: str, shape):
+        pspec = self.decode_strategy.sharding_for(layer_name).weight_pspec(wname)
+        if not constrainable(pspec, shape, self.mesh):
+            pspec = PartitionSpec()
+        return NamedSharding(self.mesh, pspec)
+
+    def init(self, seed: Optional[int] = None):
+        """Weights sharded-at-birth in the DECODE strategy's layout (the
+        steady-state program; prefill's jit reshards on entry via GSPMD).
+        Identical names/specs/topo order to the training graph mean this is
+        bitwise-identical to CompiledModel.init of the same model."""
+        seed = self.cfg.seed if seed is None else seed
+        layers = topo_order(self.decode_model.layers)
+        shardings = {
+            layer.name: {w: self._weight_sharding(layer.name, w, s.shape)
+                         for w, s in layer.weight_specs.items()}
+            for layer in layers if layer.weight_specs}
+        init_fn = build_init_fn(layers, self.model._initializer_overrides)
+        self.params = jax.jit(init_fn, out_shardings=shardings)(
+            jax.random.PRNGKey(seed))
+        self._watermarks.sample("serve_init", (self.params, self.kv.state))
+        return self.params
+
+    def load_params(self, params) -> Dict[str, Any]:
+        """Adopt trained params (e.g. from CompiledModel.params), placed
+        into the decode strategy's layout."""
+        out: Dict[str, Any] = {}
+        for layer in topo_order(self.decode_model.layers):
+            if not layer.weight_specs:
+                continue
+            lp = params[layer.name]
+            out[layer.name] = {
+                w: jax.device_put(jnp.asarray(lp[w]),
+                                  self._weight_sharding(layer.name, w, s.shape))
+                for w, s in layer.weight_specs.items()}
+        self.params = out
+        self._watermarks.sample("serve_load", (self.params, self.kv.state))
+        return out
+
+    # ------------------------------------------------------------ programs
+    def prefill(self, params, input_arrays):
+        """Run the prefill program: returns (logits, kv_state) where
+        kv_state maps each attention layer to its `[slots, S, h, d]`
+        per-head K/V for `PagedKVCache.commit_prefill`."""
+        if not tel.enabled():
+            return self._prefill_jit(params, list(input_arrays))
+        t0 = tel.now_us()
+        out = self._prefill_jit(params, list(input_arrays))
+        tel.record("serve/prefill", t0, cat="serve", slots=self.slots)
+        return out
+
+    def decode_step(self, params, state, input_arrays):
+        """One single-token step over all slots: returns (logits
+        `[slots, 1, vocab]`, new cache state with positions advanced).
+        Dispatch-only from the host's view — no sync, so the scheduler can
+        keep a bounded number of steps in flight."""
+        if not tel.enabled():
+            return self._decode_jit(params, state, list(input_arrays))
+        t0 = tel.now_us()
+        out = self._decode_jit(params, state, list(input_arrays))
+        tel.record("serve/decode_step", t0, cat="serve")
+        return out
+
+    # ---------------------------------------------------------- accounting
+    def memory_stats(self) -> Dict[str, int]:
+        """Predicted vs measured per-device residency, KV cache included —
+        the serving face of CompiledModel.memory_stats()."""
+        pred_params = 0
+        for layer in self.decode_model.layers:
+            sh = self.decode_strategy.op_shardings.get(layer.name)
+            for w, spec in layer.weight_specs.items():
+                dims = (sh.weights.get(w, []) if sh is not None else [])
+                pred_params += cm.shard_bytes(spec, dims, self.machine)
+        pred_kv = self.kv_spec.per_device_bytes(self.kv_shard_degree)
+
+        def per_device_bytes(tree):
+            if tree is None:
+                return 0
+            dev = jax.devices()[0]
+            total = 0
+            for leaf in jax.tree_util.tree_leaves(tree):
+                shards = getattr(leaf, "addressable_shards", None)
+                if shards is None:
+                    total += int(getattr(leaf, "nbytes", 0))
+                    continue
+                total += sum(s.data.nbytes for s in shards if s.device == dev)
+            return total
+
+        return {
+            "kv_shard_degree": int(self.kv_shard_degree),
+            "predicted_kv_cache_bytes": int(pred_kv),
+            "predicted_param_bytes": int(pred_params),
+            "predicted_total_bytes": int(pred_kv + pred_params),
+            "actual_param_bytes_per_device": per_device_bytes(self.params),
+            "actual_kv_cache_bytes_per_device": self.kv.device_bytes(),
+        }
+
+    def health_report(self) -> Dict[str, Any]:
+        """Predicted-vs-measured HBM watermark for the serving footprint
+        (params + KV pools), through the same WatermarkTracker the training
+        path uses."""
+        return {"watermarks":
+                self._watermarks.report(
+                    self.memory_stats()["predicted_total_bytes"])}

@@ -1,0 +1,200 @@
+"""Paged, sharded KV cache for the decode program.
+
+Layout (per attention layer): one K pool and one V pool of shape
+`[pool_pages, page_size, heads, head_dim]`, where `pool_pages =
+slots * pages_per_slot + 1` — page 0 is a reserved SCRATCH page that
+inactive slots (and any out-of-range write) land in, so every decode step
+is a fixed-shape scatter/gather with no branches. The pools are sharded
+over the heads dim along the model axis the decode strategy chose for the
+attention weights (q/k/v projections write their head shard, attention
+reads it — no resharding anywhere in the cache path, the layout-derivation
+requirement of ISSUE 10).
+
+Paging: a per-slot page table `[slots, pages_per_slot]` of int32 page ids
+maps token position t to `table[slot, t // page_size]` at offset
+`t % page_size`. Allocation assigns page ids from a host free list on
+admission (only as many pages as the request's prompt + decode budget
+needs — unused tail entries stay pointed at scratch) and returns them on
+eviction; the device-side table is refreshed by a tiny replicated
+device_put at scheduler sync points. Freed pages still hold stale K/V but
+are never attended: the per-slot position mask only exposes positions
+written by the CURRENT occupant.
+
+The pools + table + per-slot position/active vectors travel through the
+decode program as lowering state (`compile.build_forward`'s state →
+new_state channel): `state[layer_name] = {"k", "v"}`,
+`state["serve/page_table"]`, `state["serve/pos"]`, `state["serve/active"]`.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from flexflow_tpu.search.cost_model import KVCacheSpec
+
+PAGE_TABLE_KEY = "serve/page_table"
+POS_KEY = "serve/pos"
+ACTIVE_KEY = "serve/active"
+
+
+@jax.jit
+def _commit_prefill(cache_state, kv_state, slot_ids, lengths):
+    """Scatter prefilled per-head K/V (`[Bp, S, h, d]` per layer, from the
+    prefill program's kv_out state) into the pools of the slots in
+    `slot_ids`. Positions >= lengths[r] (right padding) and positions past
+    the slot's allocated pages are routed to the scratch page."""
+    new = dict(cache_state)
+    pt = cache_state[PAGE_TABLE_KEY]
+    for name, kv in kv_state.items():
+        kh, vh = kv["k"], kv["v"]
+        pool_k = cache_state[name]["k"]
+        page = pool_k.shape[1]
+        s = kh.shape[1]
+        pages = pt[slot_ids]                      # [Bp, pages_per_slot]
+        t = jnp.arange(s)
+        pg = t // page                            # [S]
+        in_range = pg < pages.shape[1]
+        pageix = jnp.where(in_range[None, :],
+                           pages[:, jnp.minimum(pg, pages.shape[1] - 1)], 0)
+        valid = t[None, :] < lengths[:, None]
+        pageix = jnp.where(valid, pageix, 0)      # padding -> scratch
+        off = jnp.broadcast_to(t % page, pageix.shape)
+        new[name] = {
+            "k": pool_k.at[pageix, off].set(kh.astype(pool_k.dtype)),
+            "v": cache_state[name]["v"].at[pageix, off].set(
+                vh.astype(pool_k.dtype)),
+        }
+    return new
+
+
+class PagedKVCache:
+    """Device-resident paged KV pools + host-side page accounting."""
+
+    def __init__(self, spec: KVCacheSpec, attn_layers: List[str],
+                 mesh: Optional[Mesh] = None, heads_axis=None,
+                 dtype=jnp.float32):
+        self.spec = spec
+        self.attn_layers = list(attn_layers)
+        self.mesh = mesh
+        self.heads_axis = None
+        pool_pspec = PartitionSpec()
+        if mesh is not None and heads_axis is not None:
+            axes = (heads_axis,) if isinstance(heads_axis, str) \
+                else tuple(heads_axis)
+            deg = 1
+            for a in axes:
+                deg *= mesh.shape.get(a, 1)
+            if all(a in mesh.shape for a in axes) and spec.heads % deg == 0:
+                self.heads_axis = heads_axis
+                pool_pspec = PartitionSpec(None, None, heads_axis, None)
+        self._pool_sharding = (NamedSharding(mesh, pool_pspec)
+                               if mesh is not None else None)
+        self._repl = (NamedSharding(mesh, PartitionSpec())
+                      if mesh is not None else None)
+        shape = (spec.pool_pages, spec.page_size, spec.heads, spec.head_dim)
+
+        def pool():
+            z = jnp.zeros(shape, dtype)
+            return (jax.device_put(z, self._pool_sharding)
+                    if self._pool_sharding is not None else z)
+
+        self.state: Dict = {n: {"k": pool(), "v": pool()}
+                            for n in self.attn_layers}
+        # host mirrors (authoritative at scheduler sync points)
+        self._table = np.zeros((spec.slots, spec.pages_per_slot), np.int32)
+        self._pos = np.zeros((spec.slots,), np.int32)
+        self._active = np.zeros((spec.slots,), np.int32)
+        self.free_pages: List[int] = list(range(1, spec.pool_pages))
+        self._slot_pages: Dict[int, List[int]] = {}
+        self._push_tables()
+
+    # ------------------------------------------------------------ host ops
+    def _put_repl(self, arr):
+        x = jnp.asarray(arr)
+        return jax.device_put(x, self._repl) if self._repl is not None else x
+
+    def _push_tables(self) -> None:
+        self.state[PAGE_TABLE_KEY] = self._put_repl(self._table)
+        self.state[POS_KEY] = self._put_repl(self._pos)
+        self.state[ACTIVE_KEY] = self._put_repl(self._active)
+
+    def free_slots(self) -> List[int]:
+        return [i for i in range(self.spec.slots) if not self._active[i]]
+
+    def pages_needed(self, total_tokens: int) -> int:
+        cap = min(int(total_tokens), self.spec.padded_len)
+        return -(-cap // self.spec.page_size)
+
+    def can_admit(self, total_tokens: int) -> bool:
+        return len(self.free_pages) >= self.pages_needed(total_tokens)
+
+    def admit(self, slot: int, prompt_len: int, total_tokens: int) -> bool:
+        """Assign pages for a sequence that will hold up to `total_tokens`
+        positions (prompt + decode budget + dispatch-ahead headroom); the
+        slot's position starts at `prompt_len` (the index the first decode
+        step writes). Returns False when the free list is short — the
+        request waits in queue (continuous batching backpressure)."""
+        if self._active[slot]:
+            raise ValueError(f"slot {slot} is occupied")
+        need = self.pages_needed(total_tokens)
+        if len(self.free_pages) < need:
+            return False
+        pages = [self.free_pages.pop() for _ in range(need)]
+        self._slot_pages[slot] = pages
+        row = np.zeros(self.spec.pages_per_slot, np.int32)
+        row[:need] = pages
+        self._table[slot] = row
+        self._pos[slot] = prompt_len
+        self._active[slot] = 1
+        return True
+
+    def evict(self, slot: int) -> None:
+        """Return the slot's pages to the free list; stale pool contents
+        are never attended (position mask) and get overwritten on reuse."""
+        self.free_pages.extend(self._slot_pages.pop(slot, []))
+        self._table[slot] = 0
+        self._pos[slot] = 0
+        self._active[slot] = 0
+
+    def sync_after(self, decode_steps: int) -> None:
+        """Host mirror of the device-side position increments: each decode
+        step advanced every active slot by one. Called at scheduler sync
+        points BEFORE admissions/evictions mutate the mirrors."""
+        self._pos += self._active * int(decode_steps)
+
+    def push(self) -> None:
+        """Publish the host mirrors to the device state (after a batch of
+        admissions/evictions)."""
+        self._push_tables()
+
+    # ---------------------------------------------------------- device ops
+    def commit_prefill(self, kv_state, slot_ids, lengths) -> None:
+        """Write the prefill program's captured K/V into the pools."""
+        self.state = _commit_prefill(
+            self.state, {n: kv_state[n] for n in self.attn_layers},
+            self._put_repl(np.asarray(slot_ids, np.int32)),
+            self._put_repl(np.asarray(lengths, np.int32)))
+
+    def adopt(self, new_state) -> None:
+        """Take ownership of the state returned by a decode step."""
+        self.state = new_state
+
+    def device_bytes(self) -> int:
+        """Pool bytes resident on device 0 (the measured side of the
+        KV-cache watermark accounting)."""
+        dev = jax.devices()[0]
+        total = 0
+        for n in self.attn_layers:
+            for leaf in (self.state[n]["k"], self.state[n]["v"]):
+                shards = getattr(leaf, "addressable_shards", None)
+                if shards is None:
+                    total += int(leaf.nbytes)
+                else:
+                    total += sum(s.data.nbytes for s in shards
+                                 if s.device == dev)
+        return total

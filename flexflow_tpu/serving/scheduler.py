@@ -1,0 +1,213 @@
+"""Continuous-batching scheduler over the two serving programs.
+
+Policy (the vLLM-style loop, on PR 2's async-dispatch discipline):
+
+- ADMISSION: at every sync point, waiting requests are placed into free
+  decode slots (page allocation permitting — a short free list is
+  backpressure, the request stays queued). Admitted prompts are right-
+  padded into the `[slots, S]` prefill batch at their slot's row, run
+  through the prefill program once ("prefill-then-join"), their K/V
+  committed into the paged cache, and their first token (argmax of the
+  last real-position logits) recorded as time-to-first-token.
+- DECODE: between sync points the host dispatches up to `dispatch_ahead`
+  single-token steps without materializing anything — each step's argmax
+  feeds the next step as a device array, the device-resident loop of the
+  async runtime (`prefetch_multi`-style overlap: the host is preparing
+  admissions while the device chews the dispatched window).
+- EVICTION: at sync points, slots whose sequence hit EOS or max-new are
+  evicted (pages freed); tokens speculatively decoded past the finish
+  line are truncated. Dispatch-ahead headroom pages are allocated at
+  admission, and the decode attention routes any out-of-range write to
+  the scratch page, so over-decode can never corrupt a neighbour.
+
+Model specifics stay out of the loop: `prompt_inputs_fn` and
+`step_inputs_fn` adapt token ids + cache state to the model's input list
+(gpt2 adapters below; the generic transformer feeds embeddings directly
+and drives the engine without this scheduler).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from flexflow_tpu import telemetry as tel
+from flexflow_tpu.serving.kv_cache import POS_KEY
+
+
+@dataclasses.dataclass
+class Request:
+    rid: int
+    prompt: List[int]
+    max_new_tokens: int
+    arrival_s: float = 0.0        # offset from scheduler start (open loop)
+    # filled by the scheduler:
+    tokens: List[int] = dataclasses.field(default_factory=list)
+    ttft_s: Optional[float] = None
+    finish_s: Optional[float] = None
+    slot: Optional[int] = None
+
+
+def gpt2_prompt_inputs(ids: np.ndarray, lengths: np.ndarray) -> List[np.ndarray]:
+    """gpt2 prefill inputs: token ids + positions 0..S-1."""
+    pos = np.broadcast_to(np.arange(ids.shape[1], dtype=np.int32), ids.shape)
+    return [ids.astype(np.int32), np.ascontiguousarray(pos)]
+
+
+def gpt2_step_inputs(tokens, state) -> List[Any]:
+    """gpt2 decode inputs: next token ids + the device-side positions (the
+    index each slot's token is written at — no host sync to build them)."""
+    return [tokens, state[POS_KEY][:, None]]
+
+
+class ContinuousBatchingScheduler:
+    def __init__(self, engine, params, prompt_inputs_fn: Callable,
+                 step_inputs_fn: Callable, eos_id: Optional[int] = None,
+                 dispatch_ahead: int = 4):
+        self.engine = engine
+        self.params = params
+        self.prompt_inputs_fn = prompt_inputs_fn
+        self.step_inputs_fn = step_inputs_fn
+        self.eos_id = eos_id
+        self.dispatch_ahead = max(1, int(dispatch_ahead))
+        self.kv = engine.kv
+        self.slots = engine.slots
+        self.seq = int(engine.prefill_model.input_tensors[0].spec.shape[1])
+        self.completed: List[Request] = []
+        # per-decode-step wall seconds at materialization granularity —
+        # the per-token latency samples the bench quantiles
+        self.step_times: List[float] = []
+        self.decode_steps = 0
+        self.prefills = 0
+
+    # ------------------------------------------------------------ helpers
+    def _admit(self, waiting: deque, active: Dict[int, Request],
+               next_host: np.ndarray, now_s: float) -> bool:
+        """Place as many waiting requests as slots/pages allow, prefill
+        them as one batch, commit K/V, record TTFT. Returns True if any
+        were admitted. Host page tables are pushed BEFORE the commit so
+        the scatter sees the new pages."""
+        free = self.kv.free_slots()
+        batch: List[Request] = []
+        while waiting and free:
+            req = waiting[0]
+            need = len(req.prompt) + req.max_new_tokens + self.dispatch_ahead
+            if not self.kv.can_admit(need):
+                break  # page backpressure: keep queued
+            slot = free.pop(0)
+            self.kv.admit(slot, len(req.prompt), need)
+            req.slot = slot
+            batch.append(waiting.popleft())
+        if not batch:
+            return False
+        self.kv.push()
+        ids = np.zeros((self.slots, self.seq), np.int32)
+        lengths = np.zeros((self.slots,), np.int32)
+        for req in batch:
+            n = min(len(req.prompt), self.seq)
+            ids[req.slot, :n] = req.prompt[:n]
+            lengths[req.slot] = n
+        logits, kv_state = self.engine.prefill(
+            self.params, self.prompt_inputs_fn(ids, lengths))
+        self.kv.commit_prefill(kv_state,
+                               np.arange(self.slots, dtype=np.int32), lengths)
+        self.prefills += 1
+        lg = np.asarray(logits)  # sync: TTFT is a real materialization
+        t_first = time.perf_counter()
+        for req in batch:
+            first = int(lg[req.slot, lengths[req.slot] - 1].argmax())
+            req.tokens.append(first)
+            req.ttft_s = (t_first - self._t0) - req.arrival_s
+            next_host[req.slot, 0] = first
+            active[req.slot] = req
+            tel.event("serve/request_admitted", cat="serve", rid=req.rid,
+                      slot=req.slot, prompt_len=int(lengths[req.slot]),
+                      ttft_s=req.ttft_s)
+        return True
+
+    def _finish(self, req: Request, now_s: float) -> None:
+        req.finish_s = now_s
+        self.kv.evict(req.slot)
+        self.completed.append(req)
+        tel.event("serve/request_done", cat="serve", rid=req.rid,
+                  tokens=len(req.tokens), ttft_s=req.ttft_s,
+                  total_s=req.finish_s - req.arrival_s)
+
+    def _truncate(self, req: Request) -> bool:
+        """Apply EOS/max-len to a request's token list; True = finished."""
+        toks = req.tokens
+        if self.eos_id is not None and self.eos_id in toks:
+            del toks[toks.index(self.eos_id) + 1:]
+            return True
+        if len(toks) >= req.max_new_tokens:
+            del toks[req.max_new_tokens:]
+            return True
+        return False
+
+    # --------------------------------------------------------------- loop
+    def run(self, requests: List[Request]) -> List[Request]:
+        """Serve `requests` (arrival_s offsets define the open-loop trace)
+        to completion; returns them with tokens + latency fields filled."""
+        self._t0 = time.perf_counter()
+        queue = deque(sorted(requests, key=lambda r: r.arrival_s))
+        waiting: deque = deque()
+        active: Dict[int, Request] = {}
+        next_host = np.zeros((self.slots, 1), np.int32)
+        state = self.kv.state
+        next_dev = jnp.asarray(next_host)
+        window_toks: List[Any] = []  # dispatched, unmaterialized [slots,1]
+        window_t0 = time.perf_counter()
+
+        def now_s():
+            return time.perf_counter() - self._t0
+
+        while queue or waiting or active:
+            while queue and queue[0].arrival_s <= now_s():
+                waiting.append(queue.popleft())
+            tel.counter("serve/queue_depth", len(waiting), cat="serve")
+            tel.counter("serve/active_slots", len(active), cat="serve")
+            want_sync = (len(window_toks) >= self.dispatch_ahead
+                         or (waiting and self.kv.free_slots())
+                         or not active)
+            if want_sync and window_toks:
+                # materialize the dispatched window: one host sync drains
+                # every step's tokens (tiny [slots,1] arrays)
+                mats = [np.asarray(t) for t in window_toks]
+                steps = len(mats)
+                t_now = time.perf_counter()
+                per_step = (t_now - window_t0) / steps
+                self.step_times.extend([per_step] * steps)
+                self.kv.adopt(state)
+                self.kv.sync_after(steps)
+                for slot, req in list(active.items()):
+                    req.tokens.extend(int(m[slot, 0]) for m in mats)
+                    if self._truncate(req):
+                        del active[slot]
+                        self._finish(req, now_s())
+                next_host = mats[-1].copy()
+                window_toks = []
+                state = self.kv.state
+                window_t0 = time.perf_counter()
+            if waiting and self.kv.free_slots():
+                if self._admit(waiting, active, next_host, now_s()):
+                    state = self.kv.state
+                    next_dev = jnp.asarray(next_host)
+                    window_t0 = time.perf_counter()
+            if not active:
+                if queue and not waiting:
+                    # open loop: idle until the next arrival
+                    time.sleep(max(0.0, queue[0].arrival_s - now_s()))
+                continue
+            inputs = self.step_inputs_fn(next_dev, state)
+            logits, state = self.engine.decode_step(self.params, state, inputs)
+            next_dev = jnp.argmax(
+                logits[:, -1, :], axis=-1).astype(jnp.int32)[:, None]
+            window_toks.append(next_dev)
+            self.decode_steps += 1
+        return self.completed

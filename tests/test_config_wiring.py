@@ -163,6 +163,38 @@ def test_resilience_flags_wired(devices):
         assert flag in vf, flag
 
 
+def test_serving_flags_wired():
+    """The ISSUE-10 serving knobs flow parse_args -> FFConfig via
+    build_parser only (the launcher's value-flag set derives from it):
+    --serve is a boolean gate, the rest consume a value token, and
+    --serve-objective is constrained to the two _score objectives."""
+    import pytest
+
+    from flexflow_tpu.config import FFConfig as Cfg
+
+    cfg = Cfg.parse_args(["--serve", "--max-decode-len", "64",
+                          "--kv-page-size", "32", "--max-batch-slots", "16",
+                          "--serve-objective", "throughput"])
+    assert cfg.serve is True
+    assert cfg.max_decode_len == 64
+    assert cfg.kv_page_size == 32
+    assert cfg.max_batch_slots == 16
+    assert cfg.serve_objective == "throughput"
+    d = Cfg()
+    assert d.serve is False           # serving is an explicit opt-in
+    assert d.max_decode_len == 0      # 0 = compile_serving's default
+    assert d.kv_page_size == 16
+    assert d.max_batch_slots == 8
+    assert d.serve_objective == "latency"
+    with pytest.raises(SystemExit):
+        Cfg.parse_args(["--serve-objective", "goodput"])
+    vf = Cfg.launcher_value_flags()
+    for flag in ("--max-decode-len", "--kv-page-size",
+                 "--max-batch-slots", "--serve-objective"):
+        assert flag in vf, flag
+    assert "--serve" not in vf        # the gate takes no value token
+
+
 def test_health_flags_wired():
     """The ISSUE-9 health knobs flow parse_args -> FFConfig via
     build_parser only (launcher value-flag set derives automatically):

@@ -88,6 +88,12 @@ FAMILIES: Dict[str, Tuple[str, Callable[[Dict[str, Any]],
                    ("goodput_baseline", "goodput_ckpt_heavy",
                     "accounted_frac_min")
                    if d.get(k) is not None]),
+    "serve": (
+        r"^BENCH_serve\.json$",
+        lambda d: [(k, float(d[k])) for k in
+                   ("tokens_per_s_per_chip", "ttft_p99_s",
+                    "per_token_p99_s")
+                   if d.get(k) is not None]),
 }
 
 

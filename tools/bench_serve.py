@@ -1,0 +1,197 @@
+"""Open-loop serving benchmark: the ISSUE 10 evidence artifact.
+
+Builds the gpt2 CPU twin, compiles the two searched serving programs
+(`compile_serving` — compute-priced prefill, bandwidth-priced decode),
+and drives the continuous-batching scheduler with an OPEN-LOOP Poisson
+arrival trace (seeded — arrivals don't wait for the server, so queueing
+delay shows up in TTFT exactly as it would against a real frontend).
+Per arrival-rate leg it reports:
+
+  tokens_per_s_per_chip — generated tokens / wall / device count
+  ttft_p50_s/ttft_p99_s — time-to-first-token quantiles (arrival ->
+      first prefill logit materialization, queueing included)
+  per_token_p50_s/per_token_p99_s — decode-step latency quantiles at
+      the scheduler's dispatch-window materialization granularity
+
+plus the serving memory accounting (predicted vs measured params + KV
+pool residency per device) through the PR 8 watermark check.
+
+  python tools/bench_serve.py                        # full twin bench
+  python tools/bench_serve.py --rates 2,8 --requests 24
+  python tools/bench_serve.py --out BENCH_serve.json
+  python tools/bench_serve.py --check   # CI smoke (tiny twin): asserts
+      every request completes with its full token budget, quantiles are
+      finite and ordered, KV bytes are accounted in memory_stats, and
+      the measured watermark sits within the predicted envelope.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def _quantile(xs, q):
+    if not xs:
+        return None
+    return float(np.quantile(np.asarray(xs, np.float64), q))
+
+
+def _build_engine(check: bool):
+    import jax
+
+    from flexflow_tpu import FFConfig, FFModel
+    from flexflow_tpu.models import GPT2Config, build_gpt2
+    from flexflow_tpu.serving import compile_serving
+
+    n_dev = len(jax.devices())
+    mesh = ({"data": 2, "model": n_dev // 2} if n_dev % 2 == 0 and n_dev > 1
+            else {"data": max(1, n_dev)})
+    cfg = FFConfig(search_budget=16, mesh_shape=mesh, log_level="warning",
+                   max_batch_slots=4, kv_page_size=4)
+    gc = (GPT2Config(vocab=256, seq=16, d_model=64, heads=2, layers=1,
+                     dropout=0.0) if check else
+          GPT2Config(vocab=512, seq=32, d_model=128, heads=4, layers=2,
+                     dropout=0.0))
+    m = FFModel(cfg)
+    build_gpt2(m, gc, batch=8)
+    eng = compile_serving(m, max_decode_len=4 if check else 8)
+    eng.init(seed=0)
+    return eng, gc, n_dev
+
+
+def _make_trace(rng, n_requests, rate, vocab, prompt_len, max_new):
+    """Open-loop Poisson arrivals: inter-arrival gaps ~ Exp(rate)."""
+    from flexflow_tpu.serving import Request
+
+    gaps = rng.exponential(1.0 / rate, size=n_requests)
+    arrivals = np.cumsum(gaps)
+    return [Request(rid=i,
+                    prompt=list(rng.integers(1, vocab, size=prompt_len)),
+                    max_new_tokens=max_new,
+                    arrival_s=float(arrivals[i]))
+            for i in range(n_requests)]
+
+
+def _run_leg(eng, gc, n_dev, rate, n_requests, seed):
+    from flexflow_tpu.serving import (ContinuousBatchingScheduler,
+                                      gpt2_prompt_inputs, gpt2_step_inputs)
+
+    rng = np.random.default_rng(seed)
+    max_new = eng.max_decode_len
+    prompt_len = max(2, gc.seq // 4)
+    reqs = _make_trace(rng, n_requests, rate, gc.vocab, prompt_len, max_new)
+    sched = ContinuousBatchingScheduler(eng, eng.params, gpt2_prompt_inputs,
+                                        gpt2_step_inputs, eos_id=None,
+                                        dispatch_ahead=4)
+    t0 = time.perf_counter()
+    done = sched.run(reqs)
+    wall = time.perf_counter() - t0
+    tokens = sum(len(r.tokens) for r in done)
+    ttfts = [r.ttft_s for r in done if r.ttft_s is not None]
+    return {
+        "arrival_rate_req_s": rate,
+        "requests": len(done),
+        "tokens": tokens,
+        "wall_s": round(wall, 3),
+        "tokens_per_s": round(tokens / wall, 2),
+        "tokens_per_s_per_chip": round(tokens / wall / n_dev, 2),
+        "ttft_p50_s": _quantile(ttfts, 0.5),
+        "ttft_p99_s": _quantile(ttfts, 0.99),
+        "per_token_p50_s": _quantile(sched.step_times, 0.5),
+        "per_token_p99_s": _quantile(sched.step_times, 0.99),
+        "decode_steps": sched.decode_steps,
+        "prefill_batches": sched.prefills,
+        "all_complete": all(len(r.tokens) == r.max_new_tokens for r in done),
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser("bench_serve")
+    p.add_argument("--rates", default="2,8",
+                   help="comma-separated open-loop arrival rates (req/s)")
+    p.add_argument("--requests", type=int, default=24)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--out", default="", help="also write the JSON here")
+    p.add_argument("--check", action="store_true",
+                   help="CI smoke: tiny twin, assert completion + ordered "
+                        "finite quantiles + KV memory accounting")
+    args = p.parse_args(argv)
+    if args.check:
+        args.requests = min(args.requests, 8)
+
+    eng, gc, n_dev = _build_engine(args.check)
+    ms = eng.memory_stats()
+    hr = eng.health_report()["watermarks"]
+    legs = []
+    for i, r in enumerate(s for s in args.rates.split(",") if s.strip()):
+        legs.append(_run_leg(eng, gc, n_dev, float(r), args.requests,
+                             args.seed + i))
+    report = {
+        "model": "gpt2 CPU twin" + (" (check)" if args.check else ""),
+        "devices": n_dev,
+        "slots": eng.slots,
+        "max_decode_len": eng.max_decode_len,
+        "kv_page_size": eng.kv_spec.page_size,
+        "prefill_vs_decode_strategy_differ": (
+            eng.prefill_strategy.op_shardings != eng.decode_strategy.op_shardings),
+        "kv_shard_degree": ms["kv_shard_degree"],
+        "memory": ms,
+        "watermark": hr,
+        "legs": legs,
+        # headline metrics (bench_history "serve" family)
+        "tokens_per_s_per_chip": max(l["tokens_per_s_per_chip"] for l in legs),
+        "ttft_p99_s": legs[-1]["ttft_p99_s"],
+        "per_token_p99_s": legs[-1]["per_token_p99_s"],
+    }
+    print(json.dumps(report, indent=1))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=1)
+
+    if args.check:
+        ok = True
+
+        def fail(msg):
+            nonlocal ok
+            ok = False
+            print("CHECK FAIL: " + msg, file=sys.stderr)
+
+        for leg in legs:
+            if leg["requests"] != args.requests or not leg["all_complete"]:
+                fail(f"rate {leg['arrival_rate_req_s']}: "
+                     f"{leg['requests']}/{args.requests} requests complete")
+            for lo, hi in (("ttft_p50_s", "ttft_p99_s"),
+                           ("per_token_p50_s", "per_token_p99_s")):
+                if not (leg[lo] is not None and leg[hi] is not None
+                        and 0.0 <= leg[lo] <= leg[hi]):
+                    fail(f"rate {leg['arrival_rate_req_s']}: quantiles "
+                         f"{lo}={leg[lo]} {hi}={leg[hi]} not ordered/finite")
+            if leg["tokens_per_s_per_chip"] <= 0:
+                fail("zero serving throughput")
+        if ms["predicted_kv_cache_bytes"] <= 0 or \
+                ms["actual_kv_cache_bytes_per_device"] != \
+                ms["predicted_kv_cache_bytes"]:
+            fail(f"KV accounting mismatch: predicted "
+                 f"{ms['predicted_kv_cache_bytes']} vs actual "
+                 f"{ms['actual_kv_cache_bytes_per_device']}")
+        if hr["ratio"] > hr["warn_ratio"]:
+            fail(f"measured watermark {hr['ratio']:.2f}x predicted "
+                 f"(warn at {hr['warn_ratio']}x)")
+        print("CHECK " + ("PASS" if ok else "FAIL"))
+        return 0 if ok else 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

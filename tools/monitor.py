@@ -56,13 +56,33 @@ def gather(events: List[Dict[str, Any]]) -> Dict[str, Any]:
     halts: List[Dict[str, Any]] = []
     faults = 0
     errors = 0
+    # serving (flexflow_tpu/serving): decode-step span durations, finished
+    # requests (tokens + ttft for the panel quantiles), live slot/queue
+    # counter samples, and the ts window tokens/s is computed over
+    serve = {"decode_ms": [], "done": [], "prefills": 0,
+             "active_slots": None, "queue_depth": None,
+             "ts_first": None, "ts_last": None}
     for ev in events:
         name = ev.get("name", "")
         args = ev.get("args") or {}
+        if name.startswith("serve/"):
+            serve["ts_first"] = (ev.get("ts") if serve["ts_first"] is None
+                                 else serve["ts_first"])
+            serve["ts_last"] = ev.get("ts", serve["ts_last"])
         if name == "health/goodput":
             goodputs.append(args)
         elif name in STEP_SPAN_NAMES and ev.get("ph") == "X":
             steps_ms.append(float(ev.get("dur", 0.0)) / 1e3)
+        elif name == "serve/decode_step" and ev.get("ph") == "X":
+            serve["decode_ms"].append(float(ev.get("dur", 0.0)) / 1e3)
+        elif name == "serve/prefill" and ev.get("ph") == "X":
+            serve["prefills"] += 1
+        elif name == "serve/request_done":
+            serve["done"].append(args)
+        elif name == "serve/active_slots":
+            serve["active_slots"] = args.get("value")
+        elif name == "serve/queue_depth":
+            serve["queue_depth"] = args.get("value")
         elif name == "health/nonfinite":
             sent["nonfinite"] += 1
             last_nonfinite = args
@@ -81,7 +101,7 @@ def gather(events: List[Dict[str, Any]]) -> Dict[str, Any]:
     return {"goodputs": goodputs, "steps_ms": steps_ms,
             "sentinels": sent, "last_nonfinite": last_nonfinite,
             "hbm": hbm, "halts": halts, "faults": faults,
-            "errors": errors, "events": len(events)}
+            "errors": errors, "events": len(events), "serve": serve}
 
 
 # ------------------------------------------------------------------- render
@@ -99,6 +119,38 @@ def sparkline(values: List[float], width: int = 48) -> str:
     span = (hi - lo) or 1.0
     return "".join(SPARK[int((v - lo) / span * (len(SPARK) - 1))]
                    for v in vals)
+
+
+def _pq(xs: List[float], q: float) -> float:
+    """Nearest-rank quantile (no numpy dependency in the render path)."""
+    s = sorted(xs)
+    return s[min(len(s) - 1, int(q * (len(s) - 1) + 0.5))]
+
+
+def _serve_stats(serve: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    """Fold the gathered serve/* stream into the panel's numbers; None
+    when the run has no serving activity (panel stays hidden)."""
+    if not (serve["done"] or serve["decode_ms"] or serve["prefills"]):
+        return None
+    tokens = sum(int(d.get("tokens", 0)) for d in serve["done"])
+    span_s = 0.0
+    if serve["ts_first"] is not None and serve["ts_last"] is not None:
+        span_s = max(0.0, (serve["ts_last"] - serve["ts_first"]) / 1e6)
+    ttfts = [float(d["ttft_s"]) for d in serve["done"]
+             if d.get("ttft_s") is not None]
+    return {
+        "requests_done": len(serve["done"]),
+        "tokens": tokens,
+        "tokens_per_s": tokens / span_s if span_s > 0 else 0.0,
+        "ttft_p50_s": _pq(ttfts, 0.5) if ttfts else None,
+        "ttft_p99_s": _pq(ttfts, 0.99) if ttfts else None,
+        "decode_p50_ms": (_pq(serve["decode_ms"], 0.5)
+                          if serve["decode_ms"] else None),
+        "decode_p99_ms": (_pq(serve["decode_ms"], 0.99)
+                          if serve["decode_ms"] else None),
+        "active_slots": serve["active_slots"],
+        "queue_depth": serve["queue_depth"],
+    }
 
 
 def render(state: Dict[str, Any]) -> List[str]:
@@ -133,6 +185,21 @@ def render(state: Dict[str, Any]) -> List[str]:
                      f"last={tail[-1]:.1f}ms "
                      f"min={min(tail):.1f} max={max(tail):.1f} "
                      f"(n={len(steps)})")
+    sv = _serve_stats(state.get("serve") or
+                      {"done": [], "decode_ms": [], "prefills": 0})
+    if sv:
+        def f(v, fmt):
+            return (fmt % v) if v is not None else "-"
+        lines.append(
+            f"serving  {sv['tokens_per_s']:.1f} tok/s "
+            f"({sv['requests_done']} reqs, {sv['tokens']} tokens)  "
+            f"ttft p50/p99 {f(sv['ttft_p50_s'], '%.3fs')}/"
+            f"{f(sv['ttft_p99_s'], '%.3fs')}  "
+            f"step p50/p99 {f(sv['decode_p50_ms'], '%.1fms')}/"
+            f"{f(sv['decode_p99_ms'], '%.1fms')}")
+        lines.append(
+            f"         active_slots={f(sv['active_slots'], '%g')} "
+            f"queue={f(sv['queue_depth'], '%g')}")
     sent = state["sentinels"]
     bad = sent["nonfinite"] or state["halts"]
     status = "FATAL" if bad else (
@@ -199,6 +266,25 @@ def prom_export(state: Dict[str, Any], path: str) -> None:
           "Max per-device peak memory across watermark samples")
     gauge("flexflow_error_events_total", float(state["errors"]),
           "Events in the reserved error category")
+    sv = _serve_stats(state.get("serve") or
+                      {"done": [], "decode_ms": [], "prefills": 0})
+    if sv:
+        gauge("flexflow_serve_tokens_per_second", sv["tokens_per_s"],
+              "Serving throughput over the telemetry window")
+        gauge("flexflow_serve_requests_done_total",
+              float(sv["requests_done"]),
+              "Completed serving requests in the telemetry stream")
+        if sv["ttft_p99_s"] is not None:
+            gauge("flexflow_serve_ttft_p99_seconds", sv["ttft_p99_s"],
+                  "p99 time-to-first-token of completed requests")
+        if sv["decode_p99_ms"] is not None:
+            gauge("flexflow_serve_decode_step_p99_seconds",
+                  sv["decode_p99_ms"] / 1e3,
+                  "p99 decode-step span duration")
+        if sv["active_slots"] is not None:
+            gauge("flexflow_serve_active_slots",
+                  float(sv["active_slots"]),
+                  "Occupied decode slots at the last counter sample")
     tmp = path + ".tmp"
     with open(tmp, "w") as f:
         f.write("\n".join(g) + "\n")
