@@ -192,6 +192,21 @@ class FFConfig:
     kv_page_size: int = 16
     max_batch_slots: int = 8
     serve_objective: str = "latency"
+    # serving resilience (ISSUE 11): hot-swap watching + SLO admission.
+    #   serve_watch_dir        — durable-checkpoint root the engine polls
+    #                            for new committed snapshots to hot-swap
+    #                            ("" = swapping off)
+    #   serve_ttft_budget_ms   — shed a request when its estimated TTFT
+    #                            exceeds this budget (0 = no budget)
+    #   serve_queue_cap        — max waiting requests before the lowest-
+    #                            priority one is shed (0 = unbounded)
+    #   serve_decode_timeout_ms— decode-window watchdog: a materialization
+    #                            slower than this per step evicts the
+    #                            longest-resident slot (0 = no watchdog)
+    serve_watch_dir: str = ""
+    serve_ttft_budget_ms: float = 0.0
+    serve_queue_cap: int = 0
+    serve_decode_timeout_ms: float = 0.0
 
     @property
     def total_devices(self) -> int:
@@ -286,6 +301,10 @@ class FFConfig:
         p.add_argument("--max-batch-slots", type=int, default=8)
         p.add_argument("--serve-objective", type=str, default="latency",
                        choices=("latency", "throughput"))
+        p.add_argument("--serve-watch-dir", type=str, default="")
+        p.add_argument("--serve-ttft-budget-ms", type=float, default=0.0)
+        p.add_argument("--serve-queue-cap", type=int, default=0)
+        p.add_argument("--serve-decode-timeout-ms", type=float, default=0.0)
         return p
 
     @staticmethod
@@ -387,4 +406,8 @@ class FFConfig:
             kv_page_size=args.kv_page_size,
             max_batch_slots=args.max_batch_slots,
             serve_objective=args.serve_objective,
+            serve_watch_dir=args.serve_watch_dir,
+            serve_ttft_budget_ms=args.serve_ttft_budget_ms,
+            serve_queue_cap=args.serve_queue_cap,
+            serve_decode_timeout_ms=args.serve_decode_timeout_ms,
         )

@@ -452,6 +452,58 @@ class WatermarkTracker:
         return rep
 
 
+# ----------------------------------------------------------- serving swaps
+class SwapStats:
+    """Hot-swap bookkeeping for the serving engine (ISSUE 11): every
+    completed swap/rollback records its wall latency and the version
+    (training step) it activated, so `health_report()["serving"]` and the
+    monitor can answer "which weights are live, how long do swaps take,
+    and has anyone rolled back" without grepping telemetry."""
+
+    def __init__(self) -> None:
+        self.active_version: Optional[int] = None
+        self.swaps = 0
+        self.rollbacks = 0
+        self.rejected = 0          # snapshots refused (fingerprint/fault)
+        self.latencies_s: List[float] = []
+        self.last_swap_s: Optional[float] = None  # wall ts of last swap
+
+    def record_swap(self, version: Optional[int], latency_s: float,
+                    rollback: bool = False) -> None:
+        self.active_version = version
+        self.latencies_s.append(float(latency_s))
+        self.last_swap_s = time.time()
+        if rollback:
+            self.rollbacks += 1
+        else:
+            self.swaps += 1
+        if tel.enabled():
+            tel.event("serve/version", cat="serve",
+                      version=-1 if version is None else int(version),
+                      latency_s=float(latency_s), rollback=bool(rollback))
+
+    def record_rejected(self) -> None:
+        self.rejected += 1
+
+    def report(self) -> Dict[str, Any]:
+        lats = sorted(self.latencies_s)
+
+        def q(p: float) -> Optional[float]:
+            if not lats:
+                return None
+            return lats[min(len(lats) - 1, int(p * (len(lats) - 1) + 0.5))]
+
+        return {
+            "active_version": self.active_version,
+            "swaps": self.swaps,
+            "rollbacks": self.rollbacks,
+            "rejected": self.rejected,
+            "swap_p50_s": q(0.5),
+            "swap_p99_s": q(0.99),
+            "last_swap_unix_s": self.last_swap_s,
+        }
+
+
 def format_health(sentinels: Optional[Dict[str, Any]],
                   watermarks: Optional[Dict[str, Any]]) -> List[str]:
     """The `[health]` report lines (profile_report; bench reuses)."""

@@ -24,6 +24,18 @@ plan can't silently arm nothing):
                         NON-RAISING: the fit loops query `poison()` and
                         corrupt the params themselves, modeling a silent
                         numerics blow-up rather than a thrown error.
+  serve/prefill         serving prefill dispatch (one index per admission
+                        batch) — a permanent fault fails the batch being
+                        admitted, never the engine
+  serve/decode_step     serving decode-step dispatch — a permanent fault
+                        makes the scheduler evict the wedged slot and
+                        keep serving the rest
+  serve/kv_admit        KV-cache page allocation at admission (one index
+                        per request) — a permanent fault sheds only that
+                        request
+  serve/param_swap      the hot-swap's durable-snapshot read — a
+                        permanent fault aborts the swap; the engine keeps
+                        serving the currently active version
 
 Plan grammar (FF_FAULT_PLAN env var or --fault-plan, comma-separated):
 
@@ -55,6 +67,10 @@ SITES = (
     "distributed/init",
     "pipe/boundary_hop",
     "health/nonfinite",
+    "serve/prefill",
+    "serve/decode_step",
+    "serve/kv_admit",
+    "serve/param_swap",
 )
 
 

@@ -7,15 +7,16 @@ decode loop. Entry point: `compile_serving(model)`.
 """
 
 from flexflow_tpu.serving.engine import ServingCompiled, compile_serving
-from flexflow_tpu.serving.kv_cache import (ACTIVE_KEY, PAGE_TABLE_KEY,
-                                           POS_KEY, PagedKVCache)
+from flexflow_tpu.serving.kv_cache import (ACTIVE_KEY, KVPoolExhausted,
+                                           PAGE_TABLE_KEY, POS_KEY,
+                                           PagedKVCache)
 from flexflow_tpu.serving.program import clone_for_serving, serving_optimize
 from flexflow_tpu.serving.scheduler import (ContinuousBatchingScheduler,
                                             Request, gpt2_prompt_inputs,
                                             gpt2_step_inputs)
 
 __all__ = [
-    "compile_serving", "ServingCompiled", "PagedKVCache",
+    "compile_serving", "ServingCompiled", "PagedKVCache", "KVPoolExhausted",
     "ContinuousBatchingScheduler", "Request", "clone_for_serving",
     "serving_optimize", "gpt2_prompt_inputs", "gpt2_step_inputs",
     "PAGE_TABLE_KEY", "POS_KEY", "ACTIVE_KEY",

@@ -14,11 +14,27 @@ Determinism is a hard default here, not a caller flag: both programs are
 traced with training=False and a FIXED rng, and every dropout in the
 clones is rate-0 — two runs of the same requests produce bitwise-identical
 logits (the inference-determinism satellite of ISSUE 10).
+
+Live hot-swap (ISSUE 11): `watch(root)` points the engine at a durable-
+checkpoint root (the resilience layer's MANIFEST.json atomic-commit
+protocol makes discovery race-free); `poll_swap()` — called by the
+scheduler between decode steps, when no dispatched window is in flight —
+loads any newer committed snapshot into a SECOND param tree (graph
+fingerprint validated first, `CheckpointMismatchError` on a foreign
+model), then activates it with a pointer flip. In-flight work holds
+references to the old tree (the serving jits never donate), so no
+request is dropped or corrupted. Previous versions are retained in
+memory (`retain` trees, default 2 = double buffer); `rollback()` re-pins
+one — pinning stops `poll_swap` auto-advancing until `unpin()`.
 """
 
 from __future__ import annotations
 
+import json
 import logging
+import os
+import time
+from collections import OrderedDict
 from typing import Any, Dict, List, Optional
 
 import jax
@@ -28,6 +44,10 @@ from jax.sharding import NamedSharding, PartitionSpec
 
 from flexflow_tpu import health
 from flexflow_tpu import telemetry as tel
+from flexflow_tpu.runtime.checkpoint import (CheckpointMismatchError,
+                                             _graph_fingerprint)
+from flexflow_tpu.runtime.resilience import (RetryPolicy, committed_snapshots,
+                                             run_resilient)
 from flexflow_tpu.compiler.compile import (build_init_fn, resolve_machine,
                                            _overlay_parallel_ops)
 from flexflow_tpu.compiler.lowering import build_forward, constrainable
@@ -173,6 +193,19 @@ class ServingCompiled:
         self._decode_jit = jax.jit(_decode)
         self.params: Optional[Dict[str, Any]] = None
 
+        # hot-swap state (ISSUE 11): watch root + retained version trees
+        self.swap_stats = health.SwapStats()
+        self._watch_root: Optional[str] = None
+        self._watch_poll_s = 0.25
+        self._last_poll = 0.0
+        self._retain = 2
+        self._versions: "OrderedDict[Any, Dict[str, Any]]" = OrderedDict()
+        self._pinned = False
+        self._bad_snapshots: set = set()
+        self._swap_policy = RetryPolicy.from_config(self.cfg)
+        if getattr(self.cfg, "serve_watch_dir", ""):
+            self.watch(self.cfg.serve_watch_dir)
+
     # ------------------------------------------------------------- weights
     def _weight_sharding(self, layer_name: str, wname: str, shape):
         pspec = self.decode_strategy.sharding_for(layer_name).weight_pspec(wname)
@@ -197,21 +230,218 @@ class ServingCompiled:
         self._watermarks.sample("serve_init", (self.params, self.kv.state))
         return self.params
 
-    def load_params(self, params) -> Dict[str, Any]:
-        """Adopt trained params (e.g. from CompiledModel.params), placed
-        into the decode strategy's layout."""
-        out: Dict[str, Any] = {}
-        for layer in topo_order(self.decode_model.layers):
-            if not layer.weight_specs:
-                continue
-            lp = params[layer.name]
-            out[layer.name] = {
-                w: jax.device_put(jnp.asarray(lp[w]),
+    def _validate_incoming(self, params, source: str) -> None:
+        """Structural check of an incoming params tree against the decode
+        graph: layer-name sets and per-weight shapes must match. Raises
+        `CheckpointMismatchError` listing the diffs — a silent zip over
+        mismatched layers would serve garbage weights."""
+        live = {l.name: l for l in topo_order(self.decode_model.layers)
+                if l.weight_specs}
+        diffs: List[str] = []
+        only_in = sorted(set(params) - set(live))
+        only_live = sorted(set(live) - set(params))
+        if only_in:
+            diffs.append(f"layers only in incoming tree: {only_in[:8]}")
+        if only_live:
+            diffs.append(f"layers only in serving graph: {only_live[:8]}")
+        for name in sorted(set(live) & set(params)):
+            lp, layer = params[name], live[name]
+            for w, s in sorted(layer.weight_specs.items()):
+                if w not in lp:
+                    diffs.append(f"{name}: missing weight {w!r}")
+                elif tuple(np.shape(lp[w])) != tuple(s.shape):
+                    diffs.append(f"{name}.{w}: shape {tuple(np.shape(lp[w]))}"
+                                 f" vs expected {tuple(s.shape)}")
+        if diffs:
+            raise CheckpointMismatchError(
+                f"params tree from {source} does not match the serving "
+                "graph:\n  " + "\n  ".join(diffs))
+
+    def _place_params(self, params, source: str = "load_params"
+                      ) -> Dict[str, Any]:
+        """Validate + place a host/training params tree into the decode
+        strategy's layout (the standby buffer of a hot-swap, or the live
+        tree for `load_params`)."""
+        self._validate_incoming(params, source)
+        return {
+            layer.name: {
+                w: jax.device_put(jnp.asarray(params[layer.name][w]),
                                   self._weight_sharding(layer.name, w, s.shape))
                 for w, s in layer.weight_specs.items()}
-        self.params = out
+            for layer in topo_order(self.decode_model.layers)
+            if layer.weight_specs}
+
+    def load_params(self, params) -> Dict[str, Any]:
+        """Adopt trained params (e.g. from CompiledModel.params), placed
+        into the decode strategy's layout. Raises `CheckpointMismatchError`
+        when the tree's layer names or weight shapes don't match the
+        serving graph."""
+        self.params = self._place_params(params)
         self._watermarks.sample("serve_load", (self.params, self.kv.state))
-        return out
+        return self.params
+
+    # ------------------------------------------------------------ hot-swap
+    @property
+    def watching(self) -> bool:
+        return bool(self._watch_root)
+
+    @property
+    def active_version(self) -> Optional[int]:
+        """Training step of the live weights (None = init/load_params)."""
+        return self.swap_stats.active_version
+
+    def watch(self, root: str, poll_interval_s: float = 0.25,
+              retain: int = 2, policy: Optional[RetryPolicy] = None
+              ) -> "ServingCompiled":
+        """Arm hot-swapping: poll `root` (a durable-checkpoint root) for
+        newer committed snapshots at `poll_interval_s` granularity,
+        retaining `retain` param trees in memory for rollback."""
+        self._watch_root = os.path.abspath(root)
+        self._watch_poll_s = float(poll_interval_s)
+        self._retain = max(1, int(retain))
+        if policy is not None:
+            self._swap_policy = policy
+        self._last_poll = 0.0
+        return self
+
+    def poll_swap(self, force: bool = False) -> bool:
+        """Discover-and-swap: if the watch root holds a committed snapshot
+        newer than the active version (and no rollback pin is set), load
+        and activate it. Called by the scheduler between decode steps —
+        never while a dispatched window is in flight. Returns True iff the
+        live params changed. A snapshot that fails validation or whose
+        read escalates past the retry budget is rejected (counted +
+        telemetry `error` event) and the engine keeps serving the current
+        version — a bad checkpoint must never take serving down."""
+        if not self._watch_root or self._pinned:
+            return False
+        now = time.monotonic()
+        if not force and now - self._last_poll < self._watch_poll_s:
+            return False
+        self._last_poll = now
+        snaps = committed_snapshots(self._watch_root)
+        if not snaps:
+            return False
+        step, path, _man = snaps[-1]
+        cur = self.swap_stats.active_version
+        if (cur is not None and step <= cur) or path in self._bad_snapshots:
+            return False
+        try:
+            self.hot_swap(path, step)
+            return True
+        except CheckpointMismatchError as e:
+            self._bad_snapshots.add(path)
+            self.swap_stats.record_rejected()
+            tel.error("serve/swap_rejected", path=path, error=str(e)[:400])
+            log.warning("hot-swap rejected %s: %s", path, e)
+            return False
+        except Exception as e:  # noqa: BLE001 — escalated read failure
+            self.swap_stats.record_rejected()
+            tel.error("serve/swap_failed", path=path, error=repr(e)[:400])
+            log.warning("hot-swap failed for %s (will retry next poll): %s",
+                        path, e)
+            return False
+
+    def hot_swap(self, path: str, step: Optional[int] = None,
+                 rollback: bool = False) -> Dict[str, Any]:
+        """Load the durable snapshot at `path` into a standby param tree
+        (fingerprint-validated, `run_resilient` around the read so a
+        transient IO fault costs a retry) and activate it with a pointer
+        flip. In-flight dispatches keep their references to the previous
+        tree — the serving jits never donate — so nothing is dropped."""
+        t0 = time.perf_counter()
+        t0_us = tel.now_us() if tel.enabled() else 0
+
+        def read():
+            with open(os.path.join(path, "meta.json")) as f:
+                meta = json.load(f)
+            saved = (meta.get("fingerprint") or {}).get("graph")
+            if saved is not None:
+                self._validate_graph_fp(saved, path)
+            import orbax.checkpoint as ocp
+            tree = ocp.StandardCheckpointer().restore(
+                os.path.join(path, "tree"))
+            return meta, tree["params"]
+
+        meta, raw = run_resilient("serve/param_swap", read,
+                                  policy=self._swap_policy)
+        placed = self._place_params(raw, source=path)
+        if step is None:
+            step = int(meta.get("iteration", -1))
+        prev, prev_version = self.params, self.swap_stats.active_version
+        self.params = placed  # THE swap: one pointer flip between steps
+        if prev is not None and prev_version not in self._versions:
+            self._versions[prev_version] = prev
+        self._versions[step] = placed
+        self._versions.move_to_end(step)
+        while len(self._versions) > self._retain:
+            oldest = next(iter(self._versions))
+            if oldest == step:
+                break
+            del self._versions[oldest]
+        lat = time.perf_counter() - t0
+        self.swap_stats.record_swap(step, lat, rollback=rollback)
+        if tel.enabled():
+            tel.record("serve/param_swap", t0_us, cat="serve",
+                       version=int(step), path=path, rollback=bool(rollback))
+        self._watermarks.sample("serve_swap", (self.params, self.kv.state))
+        log.info("hot-swap: version %s live in %.1f ms (%s)", step,
+                 1e3 * lat, path)
+        return placed
+
+    def _validate_graph_fp(self, saved_graph: Dict[str, str],
+                           path: str) -> None:
+        live = _graph_fingerprint(self.decode_model)
+        diffs: List[str] = []
+        only_ck = sorted(set(saved_graph) - set(live))
+        only_live = sorted(set(live) - set(saved_graph))
+        changed = sorted(k for k in set(saved_graph) & set(live)
+                         if saved_graph[k] != live[k])
+        if only_ck:
+            diffs.append(f"layers only in checkpoint: {only_ck[:8]}")
+        if only_live:
+            diffs.append(f"layers only in serving graph: {only_live[:8]}")
+        if changed:
+            diffs.append("layers with different weight schema "
+                         f"(op/shape/dtype): {changed[:8]}")
+        if diffs:
+            raise CheckpointMismatchError(
+                f"snapshot {path} does not match the serving graph:\n  "
+                + "\n  ".join(diffs))
+
+    def rollback(self, step: Any = "previous") -> Optional[int]:
+        """Re-pin a retained version: flip the live params back to `step`
+        (default: the most recently retained non-active version) and PIN —
+        `poll_swap` stops auto-advancing until `unpin()`, so a bad new
+        model can't immediately re-deploy itself. Falls back to reloading
+        from the watch root when the version aged out of memory."""
+        cur = self.swap_stats.active_version
+        if step == "previous":
+            candidates = [k for k in self._versions if k != cur]
+            if not candidates:
+                raise ValueError("rollback: no retained version to re-pin")
+            step = candidates[-1]
+        t0 = time.perf_counter()
+        if step in self._versions:
+            self.params = self._versions[step]
+            self._versions.move_to_end(step)
+            self.swap_stats.record_swap(step, time.perf_counter() - t0,
+                                        rollback=True)
+        else:
+            on_disk = {s: p for s, p, _m in
+                       committed_snapshots(self._watch_root or "")}
+            if step not in on_disk:
+                raise ValueError(f"rollback: version {step!r} not retained "
+                                 "in memory or on disk")
+            self.hot_swap(on_disk[step], step, rollback=True)
+        self._pinned = True
+        log.info("rollback: version %s re-pinned (auto-swap paused)", step)
+        return step if isinstance(step, int) else None
+
+    def unpin(self) -> None:
+        """Resume auto-swapping after a rollback pin."""
+        self._pinned = False
+        self._last_poll = 0.0
 
     # ------------------------------------------------------------ programs
     def prefill(self, params, input_arrays):
@@ -273,8 +503,10 @@ class ServingCompiled:
 
     def health_report(self) -> Dict[str, Any]:
         """Predicted-vs-measured HBM watermark for the serving footprint
-        (params + KV pools), through the same WatermarkTracker the training
-        path uses."""
+        (params + KV pools) through the training path's WatermarkTracker,
+        plus the hot-swap ledger: active version, swap/rollback counts,
+        swap latency quantiles."""
         return {"watermarks":
                 self._watermarks.report(
-                    self.memory_stats()["predicted_total_bytes"])}
+                    self.memory_stats()["predicted_total_bytes"]),
+                "serving": self.swap_stats.report()}

@@ -42,6 +42,23 @@ POS_KEY = "serve/pos"
 ACTIVE_KEY = "serve/active"
 
 
+class KVPoolExhausted(Exception):
+    """`admit` could not allocate the requested pages: the free list is
+    shorter than the request's prompt + decode budget. Deliberately NOT a
+    RuntimeError — pool exhaustion is backpressure, not a transient fault,
+    so `run_resilient`'s retry filter must let it surface immediately to
+    the scheduler's shed-or-queue path instead of burning backoff sleeps
+    on a condition only an eviction can clear."""
+
+    def __init__(self, slot: int, need: int, have: int):
+        super().__init__(
+            f"KV pool exhausted admitting slot {slot}: need {need} pages, "
+            f"{have} free")
+        self.slot = slot
+        self.need = need
+        self.have = have
+
+
 @jax.jit
 def _commit_prefill(cache_state, kv_state, slot_ids, lengths):
     """Scatter prefilled per-head K/V (`[Bp, S, h, d]` per layer, from the
@@ -137,13 +154,15 @@ class PagedKVCache:
         """Assign pages for a sequence that will hold up to `total_tokens`
         positions (prompt + decode budget + dispatch-ahead headroom); the
         slot's position starts at `prompt_len` (the index the first decode
-        step writes). Returns False when the free list is short — the
-        request waits in queue (continuous batching backpressure)."""
+        step writes). Raises `KVPoolExhausted` when the free list is short
+        — the scheduler's shed-or-queue path decides whether the request
+        waits (backpressure) or is shed, instead of a bare free-list
+        IndexError mid-drain."""
         if self._active[slot]:
             raise ValueError(f"slot {slot} is occupied")
         need = self.pages_needed(total_tokens)
         if len(self.free_pages) < need:
-            return False
+            raise KVPoolExhausted(slot, need, len(self.free_pages))
         pages = [self.free_pages.pop() for _ in range(need)]
         self._slot_pages[slot] = pages
         row = np.zeros(self.spec.pages_per_slot, np.int32)
@@ -161,11 +180,19 @@ class PagedKVCache:
         self._pos[slot] = 0
         self._active[slot] = 0
 
-    def sync_after(self, decode_steps: int) -> None:
+    def sync_after(self, decode_steps: int,
+                   advances: Optional[np.ndarray] = None) -> None:
         """Host mirror of the device-side position increments: each decode
         step advanced every active slot by one. Called at scheduler sync
-        points BEFORE admissions/evictions mutate the mirrors."""
-        self._pos += self._active * int(decode_steps)
+        points BEFORE admissions/evictions mutate the mirrors. `advances`
+        (per-slot committed step counts) masks finished slots: a request
+        that hit EOS mid-window only advances to its finish position, so
+        tokens speculatively decoded past the finish line never accrue to
+        its committed KV extent."""
+        if advances is not None:
+            self._pos += np.asarray(advances, np.int32) * self._active
+        else:
+            self._pos += self._active * int(decode_steps)
 
     def push(self) -> None:
         """Publish the host mirrors to the device state (after a batch of

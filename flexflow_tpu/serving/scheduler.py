@@ -13,12 +13,50 @@ Policy (the vLLM-style loop, on PR 2's async-dispatch discipline):
   single-token steps without materializing anything — each step's argmax
   feeds the next step as a device array, the device-resident loop of the
   async runtime (`prefetch_multi`-style overlap: the host is preparing
-  admissions while the device chews the dispatched window).
+  admissions while the device chews the dispatched window). The window
+  is additionally capped at the smallest remaining token budget across
+  active slots, so the loop never speculates past a max-len finish; an
+  EOS finish inside a window is masked out of the committed KV advance
+  (`sync_after(advances=...)`) and counted as `overdecode_tokens`.
 - EVICTION: at sync points, slots whose sequence hit EOS or max-new are
-  evicted (pages freed); tokens speculatively decoded past the finish
-  line are truncated. Dispatch-ahead headroom pages are allocated at
-  admission, and the decode attention routes any out-of-range write to
-  the scratch page, so over-decode can never corrupt a neighbour.
+  evicted (pages freed). The decode attention routes any out-of-range
+  write to the scratch page, so over-decode can never corrupt a
+  neighbour.
+
+SLO-aware admission & graceful degradation (ISSUE 11):
+
+- Requests carry a `priority` class (lower = more urgent; ties broken by
+  arrival) and an optional `deadline_s` TTFT deadline. The waiting queue
+  is served priority-first.
+- SHED-OR-QUEUE at admit: with `--serve-queue-cap` set, an arrival into
+  a full queue sheds the lowest-priority waiter (or the arrival itself
+  if nothing waiting is less urgent). With `--serve-ttft-budget-ms` set,
+  a waiter whose elapsed wait plus the EMA prefill service time can no
+  longer make the budget is shed instead of serving a dead-on-arrival
+  response. Deadline-expired waiters shed the same way. Prompts longer
+  than the prefill window are shed as `prompt_too_long` (they can never
+  be admitted), and `KVPoolExhausted` from a lost admission race keeps
+  the request queued (backpressure, not an error).
+- CHUNKED-PREFILL admission: `prefill_chunk_tokens` caps the summed
+  prompt length of one admission wave, so a burst of long prompts
+  spreads over several prefill batches instead of monopolizing the
+  engine while decode slots starve.
+- WATCHDOG: with `--serve-decode-timeout-ms` set, a dispatched window
+  whose per-step materialization exceeds the budget evicts the longest-
+  resident slot (outcome "timeout") instead of stalling the whole batch.
+
+Fault wrapping (ISSUE 11): prefill dispatch, KV admission, and decode
+dispatch run under `run_resilient` with the serving retry policy — a
+transient `serve/prefill` / `serve/kv_admit` / `serve/decode_step` fault
+costs a retry (telemetry `retry` events); a permanent one fails ONLY the
+affected request(s): a kv_admit escalation sheds that request, a prefill
+escalation fails the batch being admitted, a decode escalation evicts
+the wedged slot — the engine keeps serving in every case.
+
+Hot-swap integration: when the engine `watch()`es a checkpoint root, the
+loop calls `engine.poll_swap()` only while the dispatched window is
+empty — the swap's pointer flip happens BETWEEN decode steps, with no
+in-flight dispatch referencing the retiring param tree.
 
 Model specifics stay out of the loop: `prompt_inputs_fn` and
 `step_inputs_fn` adapt token ids + cache state to the model's input list
@@ -38,7 +76,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from flexflow_tpu import telemetry as tel
-from flexflow_tpu.serving.kv_cache import POS_KEY
+from flexflow_tpu.runtime.resilience import RetryPolicy, run_resilient
+from flexflow_tpu.serving.kv_cache import KVPoolExhausted, POS_KEY
 
 
 @dataclasses.dataclass
@@ -47,11 +86,15 @@ class Request:
     prompt: List[int]
     max_new_tokens: int
     arrival_s: float = 0.0        # offset from scheduler start (open loop)
+    priority: int = 1             # SLO class, lower = more urgent
+    deadline_s: Optional[float] = None  # TTFT deadline (relative to arrival)
     # filled by the scheduler:
     tokens: List[int] = dataclasses.field(default_factory=list)
     ttft_s: Optional[float] = None
     finish_s: Optional[float] = None
     slot: Optional[int] = None
+    outcome: str = ""             # "done" | "shed" | "failed" | "timeout"
+    shed_reason: str = ""
 
 
 def gpt2_prompt_inputs(ids: np.ndarray, lengths: np.ndarray) -> List[np.ndarray]:
@@ -66,10 +109,19 @@ def gpt2_step_inputs(tokens, state) -> List[Any]:
     return [tokens, state[POS_KEY][:, None]]
 
 
+def _urgency(r: Request):
+    return (r.priority, r.arrival_s, r.rid)
+
+
 class ContinuousBatchingScheduler:
     def __init__(self, engine, params, prompt_inputs_fn: Callable,
                  step_inputs_fn: Callable, eos_id: Optional[int] = None,
-                 dispatch_ahead: int = 4):
+                 dispatch_ahead: int = 4,
+                 ttft_budget_ms: Optional[float] = None,
+                 queue_cap: Optional[int] = None,
+                 decode_timeout_ms: Optional[float] = None,
+                 prefill_chunk_tokens: int = 0,
+                 retry_policy: Optional[RetryPolicy] = None):
         self.engine = engine
         self.params = params
         self.prompt_inputs_fn = prompt_inputs_fn
@@ -79,31 +131,143 @@ class ContinuousBatchingScheduler:
         self.kv = engine.kv
         self.slots = engine.slots
         self.seq = int(engine.prefill_model.input_tensors[0].spec.shape[1])
+        cfg = engine.cfg
+        self.ttft_budget_ms = float(
+            ttft_budget_ms if ttft_budget_ms is not None
+            else getattr(cfg, "serve_ttft_budget_ms", 0.0))
+        self.queue_cap = int(queue_cap if queue_cap is not None
+                             else getattr(cfg, "serve_queue_cap", 0))
+        self.decode_timeout_ms = float(
+            decode_timeout_ms if decode_timeout_ms is not None
+            else getattr(cfg, "serve_decode_timeout_ms", 0.0))
+        self.prefill_chunk_tokens = max(0, int(prefill_chunk_tokens))
+        self.retry_policy = (retry_policy if retry_policy is not None
+                             else RetryPolicy.from_config(cfg))
         self.completed: List[Request] = []
+        self.shed: List[Request] = []
+        self.failed: List[Request] = []
+        self.stats: Dict[str, int] = {
+            "shed_queue_full": 0, "shed_ttft_budget": 0, "shed_deadline": 0,
+            "shed_prompt_too_long": 0, "failed": 0, "evicted_wedged": 0,
+            "decode_timeouts": 0, "overdecode_tokens": 0, "swaps": 0}
+        self._ema_serve_ms = 0.0  # EMA of prefill wall (the shed estimator)
         # per-decode-step wall seconds at materialization granularity —
         # the per-token latency samples the bench quantiles
         self.step_times: List[float] = []
         self.decode_steps = 0
         self.prefills = 0
 
-    # ------------------------------------------------------------ helpers
-    def _admit(self, waiting: deque, active: Dict[int, Request],
+    # --------------------------------------------------------- degradation
+    def _shed(self, req: Request, reason: str, now_s: float) -> None:
+        req.outcome = "shed"
+        req.shed_reason = reason
+        req.finish_s = now_s
+        self.shed.append(req)
+        self.stats["shed_" + reason] += 1
+        tel.event("serve/request_shed", cat="serve", rid=req.rid,
+                  reason=reason, priority=req.priority,
+                  waited_s=max(0.0, now_s - req.arrival_s))
+
+    def _fail(self, req: Request, outcome: str, now_s: float,
+              err: Optional[BaseException] = None) -> None:
+        req.outcome = outcome
+        req.finish_s = now_s
+        req.slot = None
+        self.failed.append(req)
+        self.stats["failed"] += 1
+        tel.event("serve/request_failed", cat="serve", rid=req.rid,
+                  outcome=outcome, error=repr(err)[:200] if err else "")
+
+    def _enqueue(self, req: Request, waiting: List[Request],
+                 now_s: float) -> None:
+        """The shed-or-queue decision for one arrival."""
+        if len(req.prompt) > self.seq:
+            # can NEVER be admitted: the prefill program's window is fixed
+            # at `seq`; silently truncating the prompt would serve a
+            # different request than the one sent
+            self._shed(req, "prompt_too_long", now_s)
+            return
+        if self.queue_cap and len(waiting) >= self.queue_cap:
+            worst = max(waiting, key=_urgency)
+            if _urgency(req) < _urgency(worst):
+                waiting.remove(worst)
+                self._shed(worst, "queue_full", now_s)
+                waiting.append(req)
+            else:
+                self._shed(req, "queue_full", now_s)
+            return
+        waiting.append(req)
+
+    def _shed_stale(self, waiting: List[Request], now_s: float) -> None:
+        """Deadline/TTFT-budget sweep: shed waiters that can no longer be
+        served in time (their elapsed wait plus the EMA prefill service
+        time already blows the budget) — serving them would burn slots on
+        dead-on-arrival responses."""
+        for r in list(waiting):
+            waited_ms = 1e3 * (now_s - r.arrival_s)
+            if r.deadline_s is not None and now_s > r.arrival_s + r.deadline_s:
+                waiting.remove(r)
+                self._shed(r, "deadline", now_s)
+            elif self.ttft_budget_ms and \
+                    waited_ms + self._ema_serve_ms > self.ttft_budget_ms:
+                waiting.remove(r)
+                self._shed(r, "ttft_budget", now_s)
+
+    def _pick_wedged(self, active: Dict[int, Request]) -> int:
+        """Deterministic eviction choice for a wedged/faulted decode
+        batch: the longest-resident slot (most tokens; ties to the lowest
+        slot id)."""
+        return max(active.items(),
+                   key=lambda it: (len(it[1].tokens), -it[0]))[0]
+
+    def _evict_wedged(self, active: Dict[int, Request], outcome: str,
+                      now_s: float, err: Optional[BaseException]) -> None:
+        slot = self._pick_wedged(active)
+        req = active.pop(slot)
+        self.kv.evict(slot)
+        self.kv.push()
+        self.stats["evicted_wedged"] += 1
+        tel.event("serve/slot_evicted", cat="serve", rid=req.rid, slot=slot,
+                  outcome=outcome, tokens=len(req.tokens))
+        self._fail(req, outcome, now_s, err)
+
+    # ------------------------------------------------------------ admission
+    def _admit(self, waiting: List[Request], active: Dict[int, Request],
                next_host: np.ndarray, now_s: float) -> bool:
-        """Place as many waiting requests as slots/pages allow, prefill
-        them as one batch, commit K/V, record TTFT. Returns True if any
-        were admitted. Host page tables are pushed BEFORE the commit so
-        the scatter sees the new pages."""
+        """Place as many waiting requests as slots/pages/chunk budget
+        allow (priority-first), prefill them as one batch, commit K/V,
+        record TTFT. Returns True if any were admitted. Host page tables
+        are pushed BEFORE the commit so the scatter sees the new pages."""
         free = self.kv.free_slots()
         batch: List[Request] = []
-        while waiting and free:
-            req = waiting[0]
+        chunk_used = 0
+        waiting.sort(key=_urgency)
+        i = 0
+        while i < len(waiting) and free:
+            req = waiting[i]
+            if self.prefill_chunk_tokens and batch and \
+                    chunk_used + len(req.prompt) > self.prefill_chunk_tokens:
+                break  # chunked admission: the rest joins the next wave
             need = len(req.prompt) + req.max_new_tokens + self.dispatch_ahead
             if not self.kv.can_admit(need):
                 break  # page backpressure: keep queued
-            slot = free.pop(0)
-            self.kv.admit(slot, len(req.prompt), need)
+            slot = free[0]
+            try:
+                run_resilient(
+                    "serve/kv_admit",
+                    lambda s=slot, r=req, n=need:
+                        self.kv.admit(s, len(r.prompt), n),
+                    policy=self.retry_policy)
+            except KVPoolExhausted:
+                break  # lost a race below can_admit: keep queued
+            except Exception as e:  # noqa: BLE001 — escalated injected/IO
+                waiting.pop(i)
+                self._fail(req, "failed", now_s, e)
+                continue
+            free.pop(0)
             req.slot = slot
-            batch.append(waiting.popleft())
+            chunk_used += len(req.prompt)
+            batch.append(waiting.pop(i))
         if not batch:
             return False
         self.kv.push()
@@ -113,13 +277,27 @@ class ContinuousBatchingScheduler:
             n = min(len(req.prompt), self.seq)
             ids[req.slot, :n] = req.prompt[:n]
             lengths[req.slot] = n
-        logits, kv_state = self.engine.prefill(
-            self.params, self.prompt_inputs_fn(ids, lengths))
+        t_pre = time.perf_counter()
+        try:
+            logits, kv_state = run_resilient(
+                "serve/prefill",
+                lambda: self.engine.prefill(
+                    self.params, self.prompt_inputs_fn(ids, lengths)),
+                policy=self.retry_policy)
+        except Exception as e:  # noqa: BLE001 — permanent prefill fault:
+            for req in batch:   # fail ONLY the batch being admitted
+                self.kv.evict(req.slot)
+                self._fail(req, "failed", self._now(), e)
+            self.kv.push()
+            return False
         self.kv.commit_prefill(kv_state,
                                np.arange(self.slots, dtype=np.int32), lengths)
         self.prefills += 1
         lg = np.asarray(logits)  # sync: TTFT is a real materialization
         t_first = time.perf_counter()
+        serve_ms = 1e3 * (t_first - t_pre)
+        self._ema_serve_ms = (serve_ms if not self._ema_serve_ms
+                              else 0.5 * self._ema_serve_ms + 0.5 * serve_ms)
         for req in batch:
             first = int(lg[req.slot, lengths[req.slot] - 1].argmax())
             req.tokens.append(first)
@@ -128,10 +306,12 @@ class ContinuousBatchingScheduler:
             active[req.slot] = req
             tel.event("serve/request_admitted", cat="serve", rid=req.rid,
                       slot=req.slot, prompt_len=int(lengths[req.slot]),
-                      ttft_s=req.ttft_s)
+                      priority=req.priority, ttft_s=req.ttft_s)
         return True
 
+    # ------------------------------------------------------------- finish
     def _finish(self, req: Request, now_s: float) -> None:
+        req.outcome = "done"
         req.finish_s = now_s
         self.kv.evict(req.slot)
         self.completed.append(req)
@@ -150,13 +330,72 @@ class ContinuousBatchingScheduler:
             return True
         return False
 
+    def _window_cap(self, active: Dict[int, Request]) -> int:
+        """Dispatch-window length: bounded by `dispatch_ahead` AND the
+        smallest remaining token budget across active slots, so the loop
+        never speculates past a max-len finish (the `scheduler.py`
+        over-decode waste fix of ISSUE 11)."""
+        if not active:
+            return self.dispatch_ahead
+        rem = min(r.max_new_tokens - len(r.tokens) for r in active.values())
+        return max(1, min(self.dispatch_ahead, rem))
+
+    def _materialize(self, window_toks: List[Any], state,
+                     active: Dict[int, Request], window_t0: float
+                     ) -> np.ndarray:
+        """Drain a dispatched window: one host sync pulls every step's
+        tokens, advances the host KV mirrors (per-slot — an EOS finish
+        inside the window is masked out of the committed advance), evicts
+        finished slots, and applies the decode watchdog. Returns the last
+        step's tokens (the next window's seed)."""
+        mats = [np.asarray(t) for t in window_toks]
+        steps = len(mats)
+        t_now = time.perf_counter()
+        per_step = (t_now - window_t0) / steps
+        self.step_times.extend([per_step] * steps)
+        adv = np.zeros((self.slots,), np.int32)
+        finished: List[int] = []
+        for slot, req in active.items():
+            prev = len(req.tokens)
+            req.tokens.extend(int(m[slot, 0]) for m in mats)
+            if self._truncate(req):
+                kept = max(0, len(req.tokens) - prev)
+                adv[slot] = kept
+                self.stats["overdecode_tokens"] += steps - kept
+                finished.append(slot)
+            else:
+                adv[slot] = steps
+        self.kv.adopt(state)
+        self.kv.sync_after(steps, advances=adv)
+        for slot in finished:
+            self._finish(active.pop(slot), self._now())
+        if self.stats["overdecode_tokens"]:
+            tel.counter("serve/overdecode_tokens",
+                        self.stats["overdecode_tokens"], cat="serve")
+        if self.decode_timeout_ms and active and \
+                per_step * 1e3 > self.decode_timeout_ms:
+            # bounded-step watchdog: the window came back slower than the
+            # per-step budget — evict the longest-resident slot instead
+            # of letting one wedged sequence stall every neighbour
+            self.stats["decode_timeouts"] += 1
+            tel.event("serve/decode_timeout", cat="serve",
+                      per_step_ms=1e3 * per_step,
+                      budget_ms=self.decode_timeout_ms)
+            self._evict_wedged(active, "timeout", self._now(), None)
+        return mats[-1].copy()
+
+    def _now(self) -> float:
+        return time.perf_counter() - self._t0
+
     # --------------------------------------------------------------- loop
     def run(self, requests: List[Request]) -> List[Request]:
         """Serve `requests` (arrival_s offsets define the open-loop trace)
-        to completion; returns them with tokens + latency fields filled."""
+        to completion; returns the COMPLETED ones with tokens + latency
+        fields filled. Shed and failed requests land in `self.shed` /
+        `self.failed` with their outcome + reason stamped."""
         self._t0 = time.perf_counter()
-        queue = deque(sorted(requests, key=lambda r: r.arrival_s))
-        waiting: deque = deque()
+        queue = deque(sorted(requests, key=lambda r: (r.arrival_s, r.rid)))
+        waiting: List[Request] = []
         active: Dict[int, Request] = {}
         next_host = np.zeros((self.slots, 1), np.int32)
         state = self.kv.state
@@ -164,48 +403,64 @@ class ContinuousBatchingScheduler:
         window_toks: List[Any] = []  # dispatched, unmaterialized [slots,1]
         window_t0 = time.perf_counter()
 
-        def now_s():
-            return time.perf_counter() - self._t0
-
         while queue or waiting or active:
-            while queue and queue[0].arrival_s <= now_s():
-                waiting.append(queue.popleft())
+            now = self._now()
+            while queue and queue[0].arrival_s <= now:
+                self._enqueue(queue.popleft(), waiting, now)
             tel.counter("serve/queue_depth", len(waiting), cat="serve")
             tel.counter("serve/active_slots", len(active), cat="serve")
-            want_sync = (len(window_toks) >= self.dispatch_ahead
+            want_sync = (len(window_toks) >= self._window_cap(active)
                          or (waiting and self.kv.free_slots())
                          or not active)
             if want_sync and window_toks:
                 # materialize the dispatched window: one host sync drains
                 # every step's tokens (tiny [slots,1] arrays)
-                mats = [np.asarray(t) for t in window_toks]
-                steps = len(mats)
-                t_now = time.perf_counter()
-                per_step = (t_now - window_t0) / steps
-                self.step_times.extend([per_step] * steps)
-                self.kv.adopt(state)
-                self.kv.sync_after(steps)
-                for slot, req in list(active.items()):
-                    req.tokens.extend(int(m[slot, 0]) for m in mats)
-                    if self._truncate(req):
-                        del active[slot]
-                        self._finish(req, now_s())
-                next_host = mats[-1].copy()
+                next_host = self._materialize(window_toks, state, active,
+                                              window_t0)
                 window_toks = []
                 state = self.kv.state
                 window_t0 = time.perf_counter()
+            if not window_toks and self.engine.watching:
+                # safe swap point: nothing dispatched references params
+                if self.engine.poll_swap():
+                    self.params = self.engine.params
+                    self.stats["swaps"] += 1
+                    state = self.kv.state
+            if waiting:
+                self._shed_stale(waiting, self._now())
             if waiting and self.kv.free_slots():
-                if self._admit(waiting, active, next_host, now_s()):
+                if self._admit(waiting, active, next_host, self._now()):
                     state = self.kv.state
                     next_dev = jnp.asarray(next_host)
                     window_t0 = time.perf_counter()
             if not active:
                 if queue and not waiting:
-                    # open loop: idle until the next arrival
-                    time.sleep(max(0.0, queue[0].arrival_s - now_s()))
+                    # open loop: idle until the next arrival (short naps
+                    # when watching, so snapshot polls keep happening)
+                    wait = max(0.0, queue[0].arrival_s - self._now())
+                    time.sleep(min(wait, 0.05) if self.engine.watching
+                               else wait)
                 continue
             inputs = self.step_inputs_fn(next_dev, state)
-            logits, state = self.engine.decode_step(self.params, state, inputs)
+            try:
+                logits, state = run_resilient(
+                    "serve/decode_step",
+                    lambda s=state, ins=inputs:
+                        self.engine.decode_step(self.params, s, ins),
+                    policy=self.retry_policy)
+            except Exception as e:  # noqa: BLE001 — permanent decode fault
+                # drain what WAS dispatched successfully, then evict the
+                # wedged slot; every other slot keeps serving
+                if window_toks:
+                    next_host = self._materialize(window_toks, state, active,
+                                                  window_t0)
+                    window_toks = []
+                if active:
+                    self._evict_wedged(active, "failed", self._now(), e)
+                state = self.kv.state
+                next_dev = jnp.asarray(next_host)
+                window_t0 = time.perf_counter()
+                continue
             next_dev = jnp.argmax(
                 logits[:, -1, :], axis=-1).astype(jnp.int32)[:, None]
             window_toks.append(next_dev)

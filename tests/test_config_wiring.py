@@ -195,6 +195,32 @@ def test_serving_flags_wired():
     assert "--serve" not in vf        # the gate takes no value token
 
 
+def test_serving_resilience_flags_wired():
+    """The ISSUE-11 serving-under-fire knobs flow parse_args -> FFConfig
+    via build_parser only: hot-swap watch root, TTFT-budget shedding,
+    queue cap, and the decode watchdog. All default OFF — a scheduler
+    built without them carries zero admission-control overhead."""
+    from flexflow_tpu.config import FFConfig as Cfg
+
+    cfg = Cfg.parse_args(["--serve-watch-dir", "/tmp/ckpts",
+                          "--serve-ttft-budget-ms", "250.5",
+                          "--serve-queue-cap", "32",
+                          "--serve-decode-timeout-ms", "75.0"])
+    assert cfg.serve_watch_dir == "/tmp/ckpts"
+    assert cfg.serve_ttft_budget_ms == 250.5
+    assert cfg.serve_queue_cap == 32
+    assert cfg.serve_decode_timeout_ms == 75.0
+    d = Cfg()
+    assert d.serve_watch_dir == ""          # no watch -> no polling
+    assert d.serve_ttft_budget_ms == 0.0    # 0 = shedding off
+    assert d.serve_queue_cap == 0           # 0 = unbounded queue
+    assert d.serve_decode_timeout_ms == 0.0  # 0 = watchdog off
+    vf = Cfg.launcher_value_flags()
+    for flag in ("--serve-watch-dir", "--serve-ttft-budget-ms",
+                 "--serve-queue-cap", "--serve-decode-timeout-ms"):
+        assert flag in vf, flag
+
+
 def test_health_flags_wired():
     """The ISSUE-9 health knobs flow parse_args -> FFConfig via
     build_parser only (launcher value-flag set derives automatically):

@@ -94,6 +94,12 @@ FAMILIES: Dict[str, Tuple[str, Callable[[Dict[str, Any]],
                    ("tokens_per_s_per_chip", "ttft_p99_s",
                     "per_token_p99_s")
                    if d.get(k) is not None]),
+    "swap": (
+        r"^BENCH_swap\.json$",
+        lambda d: [(k, float(d[k])) for k in
+                   ("swaps_completed", "swap_p99_s", "dropped_inflight",
+                    "overload_shed", "served_ttft_p99_s", "legs_passed")
+                   if d.get(k) is not None]),
 }
 
 

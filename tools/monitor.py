@@ -61,7 +61,10 @@ def gather(events: List[Dict[str, Any]]) -> Dict[str, Any]:
     # counter samples, and the ts window tokens/s is computed over
     serve = {"decode_ms": [], "done": [], "prefills": 0,
              "active_slots": None, "queue_depth": None,
-             "ts_first": None, "ts_last": None}
+             "ts_first": None, "ts_last": None,
+             # ISSUE 11: hot-swap + degradation stream
+             "swap_ms": [], "active_version": None, "rollbacks": 0,
+             "shed": 0, "failed": 0, "evicted": 0, "retries": 0}
     for ev in events:
         name = ev.get("name", "")
         args = ev.get("args") or {}
@@ -79,6 +82,25 @@ def gather(events: List[Dict[str, Any]]) -> Dict[str, Any]:
             serve["prefills"] += 1
         elif name == "serve/request_done":
             serve["done"].append(args)
+        elif name == "serve/param_swap" and ev.get("ph") == "X":
+            serve["swap_ms"].append(float(ev.get("dur", 0.0)) / 1e3)
+            if args.get("version") is not None:
+                serve["active_version"] = args.get("version")
+        elif name == "serve/version":
+            # rollbacks counted HERE only: a disk-reload rollback emits
+            # both a param_swap span and a version event — one increment
+            serve["active_version"] = args.get("version",
+                                               serve["active_version"])
+            if args.get("rollback"):
+                serve["rollbacks"] += 1
+        elif name == "serve/request_shed":
+            serve["shed"] += 1
+        elif name == "serve/request_failed":
+            serve["failed"] += 1
+        elif name == "serve/slot_evicted":
+            serve["evicted"] += 1
+        elif name == "retry" and str(args.get("site", "")).startswith("serve/"):
+            serve["retries"] += 1
         elif name == "serve/active_slots":
             serve["active_slots"] = args.get("value")
         elif name == "serve/queue_depth":
@@ -150,6 +172,15 @@ def _serve_stats(serve: Dict[str, Any]) -> Optional[Dict[str, Any]]:
                           if serve["decode_ms"] else None),
         "active_slots": serve["active_slots"],
         "queue_depth": serve["queue_depth"],
+        "shed": serve.get("shed", 0),
+        "failed": serve.get("failed", 0),
+        "evicted": serve.get("evicted", 0),
+        "serve_retries": serve.get("retries", 0),
+        "swaps": len(serve.get("swap_ms", [])),
+        "swap_p99_ms": (_pq(serve["swap_ms"], 0.99)
+                        if serve.get("swap_ms") else None),
+        "active_version": serve.get("active_version"),
+        "rollbacks": serve.get("rollbacks", 0),
     }
 
 
@@ -199,7 +230,14 @@ def render(state: Dict[str, Any]) -> List[str]:
             f"{f(sv['decode_p99_ms'], '%.1fms')}")
         lines.append(
             f"         active_slots={f(sv['active_slots'], '%g')} "
-            f"queue={f(sv['queue_depth'], '%g')}")
+            f"queue={f(sv['queue_depth'], '%g')} "
+            f"shed={sv['shed']} failed={sv['failed']} "
+            f"evicted={sv['evicted']} retries={sv['serve_retries']}")
+        if sv["swaps"] or sv["rollbacks"] or sv["active_version"] is not None:
+            lines.append(
+                f"         params v{f(sv['active_version'], '%g')}  "
+                f"swaps={sv['swaps']} rollbacks={sv['rollbacks']} "
+                f"swap p99 {f(sv['swap_p99_ms'], '%.1fms')}")
     sent = state["sentinels"]
     bad = sent["nonfinite"] or state["halts"]
     status = "FATAL" if bad else (
@@ -285,6 +323,26 @@ def prom_export(state: Dict[str, Any], path: str) -> None:
             gauge("flexflow_serve_active_slots",
                   float(sv["active_slots"]),
                   "Occupied decode slots at the last counter sample")
+        gauge("flexflow_serve_shed_total", float(sv["shed"]),
+              "Requests shed by SLO-aware admission control")
+        gauge("flexflow_serve_failed_total", float(sv["failed"]),
+              "Requests failed/evicted by faults or watchdog timeouts")
+        gauge("flexflow_serve_evictions_total", float(sv["evicted"]),
+              "Decode slots force-evicted (wedged or timed out)")
+        gauge("flexflow_serve_retries_total", float(sv["serve_retries"]),
+              "Transient serve/* faults absorbed by retry")
+        gauge("flexflow_serve_swaps_total", float(sv["swaps"]),
+              "Live parameter hot-swaps completed")
+        gauge("flexflow_serve_rollbacks_total", float(sv["rollbacks"]),
+              "Parameter rollbacks to a retained version")
+        if sv["active_version"] is not None:
+            gauge("flexflow_serve_active_version",
+                  float(sv["active_version"]),
+                  "Checkpoint step of the live parameter version")
+        if sv["swap_p99_ms"] is not None:
+            gauge("flexflow_serve_swap_p99_seconds",
+                  sv["swap_p99_ms"] / 1e3,
+                  "p99 hot-swap latency (read+validate+place+flip)")
     tmp = path + ".tmp"
     with open(tmp, "w") as f:
         f.write("\n".join(g) + "\n")
