@@ -398,6 +398,12 @@ class CompiledModel:
         self._watermarks = health.WatermarkTracker()
         self._sentinels: Optional[health.SentinelMonitor] = None
 
+        # --remat compat alias (deprecated): uniform "full" per-layer policy.
+        # The searched path (--remat-search) arrives here with the DP's
+        # per-layer choices already on strategy.remat.
+        if self.cfg.remat and not getattr(strategy, "remat", None):
+            strategy.remat = {l.name: "full" for l in model.layers}
+
         self.forward_fn = build_forward(model.layers, model.input_tensors, outputs,
                                         mesh, strategy,
                                         seq_length=self.cfg.seq_length or None,
@@ -535,7 +541,6 @@ class CompiledModel:
         forward_fn = self.forward_fn
         loss_type, metric_types = self.loss_type, self.metrics
         tx = self.tx
-        remat = self.cfg.remat
         # --allow-tensor-op-math-conversion (reference config.h / cuBLAS
         # tensor-op gate ≙ the MXU's reduced-precision passes): when off,
         # every dot runs at HIGHEST precision (f32 accumulation passes)
@@ -549,6 +554,29 @@ class CompiledModel:
         # extra host syncs; the fit loop pops the reserved keys off
         # before user-facing metric accounting
         sentinels = bool(getattr(self.cfg, "health_sentinels", False))
+
+        # fused cross-entropy (kernels/fused_ce.py): the sparse-CE loss
+        # computed blockwise over the vocab axis, so the training step never
+        # holds an f32 copy of the [B, S, vocab] logits
+        fused_loss_mode = str(getattr(self.cfg, "fused_loss", "auto"))
+        fusion_on = bool(self.cfg.enable_fusion)
+        from flexflow_tpu.kernels import fused_ce as _fce
+
+        # fused optimizer update (kernels/fused_optim.py): one elementwise
+        # kernel per param block instead of the optax tree_map chain —
+        # recognized Adam/SGD configs only, silent tx.update fallback in
+        # "auto" mode, hard error in "on" mode
+        fused_opt_mode = str(getattr(self.cfg, "fused_optimizer", "auto"))
+        fopt_plan = None
+        if fused_opt_mode != "off" and (fusion_on or fused_opt_mode == "on"):
+            from flexflow_tpu.kernels import fused_optim as _fopt
+
+            fopt_plan = _fopt.plan_for(self.optimizer)
+            if fused_opt_mode == "on" and fopt_plan is None:
+                raise ValueError(
+                    f"--fused-optimizer=on but "
+                    f"{type(self.optimizer).__name__} is not a recognized "
+                    f"Adam/SGD configuration")
 
         # ZeRO machinery: the moment/opt-state sharding trees are fixed by
         # (strategy, mesh, optimizer), so build them once per compile and
@@ -564,12 +592,18 @@ class CompiledModel:
 
         def value_and_grads(params, state, inputs, label, rng):
             def loss_fn(p):
-                fwd = forward_fn
-                if remat:
-                    fwd = jax.checkpoint(forward_fn, static_argnums=(3,))
-                outs, new_state = fwd(p, state, inputs, True, rng)
+                # rematerialization is per-layer now (strategy.remat applied
+                # inside build_forward); --remat aliases to all-layers "full"
+                outs, new_state = forward_fn(p, state, inputs, True, rng)
                 logits = outs[0]
-                loss = compute_loss(loss_type, logits.astype(jnp.float32), label)
+                if _fce.use_fused_ce(loss_type, logits, fused_loss_mode,
+                                     fusion_on):
+                    # native-dtype logits: the f32 copy the reference path
+                    # takes below is exactly the materialization we avoid
+                    loss = _fce.fused_cross_entropy(logits, label)
+                else:
+                    loss = compute_loss(loss_type,
+                                        logits.astype(jnp.float32), label)
                 for (ln, wn), terms in regularizers.items():
                     w = p[ln][wn].astype(jnp.float32)
                     for mode, lam in terms:
@@ -589,7 +623,20 @@ class CompiledModel:
             memory and update flops."""
             if zero != "off":
                 grads = wsc(grads, moment_sh)
-            updates, opt_state = tx.update(grads, opt_state, params)
+            done = None
+            if fopt_plan is not None:
+                from flexflow_tpu.kernels import fused_optim as _fopt
+
+                done = _fopt.fused_update(fopt_plan, grads, opt_state,
+                                          params)
+                if done is None and fused_opt_mode == "on":
+                    raise ValueError(
+                        "--fused-optimizer=on but the live optax state does "
+                        "not match the recognized optimizer plan")
+            if done is not None:
+                updates, opt_state = done
+            else:
+                updates, opt_state = tx.update(grads, opt_state, params)
             if zero != "off":
                 updates = wsc(updates, pshards)      # all-gather
                 opt_state = wsc(opt_state, opt_sh)   # moments stay sharded

@@ -71,6 +71,18 @@ def build_forward(
     op_attrs = {name: dict(sh.attrs)
                 for name, sh in strategy.op_shardings.items() if sh.attrs}
 
+    # per-layer rematerialization (searched by the memory-aware DP, or the
+    # uniform --remat compat alias): "full" saves only the layer's inputs
+    # and recomputes everything in the backward pass; "dots" keeps matmul
+    # results (jax.checkpoint_policies.checkpoint_dots) and recomputes the
+    # cheap elementwise tail. Recompute reuses the SAME rng (fold_in of the
+    # layer guid is deterministic), so remat never changes numerics.
+    remat_map: Dict[str, str] = dict(getattr(strategy, "remat", None) or {})
+    _ckpt_policies = {
+        "full": None,
+        "dots": jax.checkpoint_policies.checkpoint_dots,
+    }
+
     from flexflow_tpu.ops.op_type import OperatorType as _OT
 
     _norm_types = (_OT.LAYERNORM, _OT.BATCHNORM)
@@ -125,12 +137,37 @@ def build_forward(
                          if k not in ex and jnp.issubdtype(v.dtype, jnp.floating)
                          else v)
                      for k, v in w.items()}
-            with scope:
-                outs = get_op_def(layer.op_type).lower(layer, ins, w, ctx)
-                if mesh is not None:
-                    sh = strategy.sharding_for(layer.name)
-                    outs = [maybe_constrain(o, sh.output_pspec(i), mesh)
-                            for i, o in enumerate(outs)]
+            pol = remat_map.get(layer.name)
+            if pol in _ckpt_policies:
+                # run the layer inside jax.checkpoint as a pure function of
+                # (ins, w, state, rng): the sub-ctx isolates new_state so
+                # stateful updates come back as an explicit output instead
+                # of leaking tracers through the closed-over ctx
+                def _one(l_ins, l_w, l_state, l_rng, _l=layer):
+                    sub = LoweringCtx(
+                        training=training, rng=l_rng, seq_length=seq_length,
+                        state=l_state,
+                        compute_dtype=str(cast_to) if cast_to else None,
+                        mesh=mesh, op_attrs=op_attrs,
+                        enable_fusion=enable_fusion)
+                    l_outs = get_op_def(_l.op_type).lower(_l, l_ins, l_w, sub)
+                    if mesh is not None:
+                        l_sh = strategy.sharding_for(_l.name)
+                        l_outs = [maybe_constrain(o, l_sh.output_pspec(i),
+                                                  mesh)
+                                  for i, o in enumerate(l_outs)]
+                    return l_outs, sub.new_state
+                ckpt = jax.checkpoint(_one, policy=_ckpt_policies[pol])
+                with scope:
+                    outs, delta = ckpt(ins, w, dict(ctx.state), ctx.rng)
+                ctx.new_state.update(delta)
+            else:
+                with scope:
+                    outs = get_op_def(layer.op_type).lower(layer, ins, w, ctx)
+                    if mesh is not None:
+                        sh = strategy.sharding_for(layer.name)
+                        outs = [maybe_constrain(o, sh.output_pspec(i), mesh)
+                                for i, o in enumerate(outs)]
             for t, o in zip(layer.outputs, outs):
                 env[t.guid] = o
         result = [env[t.guid] for t in outputs]

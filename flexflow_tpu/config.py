@@ -148,7 +148,33 @@ class FFConfig:
     profile_ops: bool = False
     allow_tensor_op_math_conversion: bool = True  # = bf16 matmul policy
     compute_dtype: str = "float32"  # params dtype; "bfloat16" enables mixed policy
-    remat: bool = False  # jax.checkpoint the forward for memory
+    # rematerialization. --remat is the legacy GLOBAL bool (deprecated in
+    # favor of the searched form): it now maps to a uniform "full"
+    # per-layer policy at compile. --remat-search promotes remat to a
+    # per-layer SEARCH dimension: the frontier DP prices each layer's
+    # policy candidates (--remat-policies, from none/dots/full) with the
+    # real memory-saved vs recompute-time tradeoff under --memory-search's
+    # HBM cap, so activation memory trades against FLOPs deliberately
+    # instead of forcing ZeRO or pipelining. The two flags contradict:
+    # combining them is rejected (see _check_remat_knobs).
+    remat: bool = False  # DEPRECATED alias: uniform "full" policy
+    remat_search: bool = False
+    remat_policies: str = "none,dots,full"
+    # Pallas fusion suite gates (flexflow_tpu/kernels): "auto" uses the
+    # fused kernel when the backend/shape supports it (TPU, or interpret
+    # mode where exercised explicitly) and falls back to the reference
+    # path otherwise; "on" forces the fused path (interpret mode on CPU —
+    # tests/benches); "off" never fuses.
+    #   fused_loss      — fused cross-entropy (kernels/fused_ce.py): the
+    #                     [B,S,vocab] logits' softmax stats are computed
+    #                     blockwise (online log-sum-exp) so the loss never
+    #                     materializes the f32 logits copy
+    #   fused_optimizer — fused Adam/SGD moment update
+    #                     (kernels/fused_optim.py): one elementwise kernel
+    #                     per param block, composing with ZeRO's scattered
+    #                     moments
+    fused_loss: str = "auto"
+    fused_optimizer: str = "auto"
     donate_state: bool = True
     # observability
     # unified telemetry (flexflow_tpu/telemetry.py): span/counter JSONL
@@ -207,6 +233,39 @@ class FFConfig:
     serve_ttft_budget_ms: float = 0.0
     serve_queue_cap: int = 0
     serve_decode_timeout_ms: float = 0.0
+
+    REMAT_POLICY_NAMES = ("none", "dots", "full")
+
+    def __post_init__(self):
+        self._check_remat_knobs()
+
+    def _check_remat_knobs(self):
+        """--remat (the deprecated global bool) and the searched-remat
+        knobs contradict each other: the alias pins every layer to "full"
+        while the search exists to pick per-layer policies. Fail loud
+        instead of silently letting one win."""
+        if self.remat and self.remat_search:
+            raise ValueError(
+                "--remat (deprecated: uniform 'full' remat) contradicts "
+                "--remat-search (per-layer searched remat); drop --remat "
+                "— the search's candidate set already includes 'full'")
+        bad = [pol for pol in self.remat_policy_list()
+               if pol not in self.REMAT_POLICY_NAMES]
+        if bad:
+            raise ValueError(
+                f"unknown remat policies {bad!r} in "
+                f"remat_policies={self.remat_policies!r} "
+                f"(choose from {', '.join(self.REMAT_POLICY_NAMES)})")
+
+    def remat_policy_list(self) -> Tuple[str, ...]:
+        """The per-layer remat-policy candidate set the DP searches over
+        (parsed from --remat-policies; "none" is always a candidate so the
+        search can keep a layer unrematerialized)."""
+        pols = tuple(s.strip() for s in self.remat_policies.split(",")
+                     if s.strip())
+        if "none" not in pols:
+            pols = ("none",) + pols
+        return pols
 
     @property
     def total_devices(self) -> int:
@@ -292,7 +351,16 @@ class FFConfig:
                        action=argparse.BooleanOptionalAction, default=True)
         p.add_argument("--halt-on-nonfinite", action="store_true")
         p.add_argument("--compute-dtype", type=str, default="float32")
-        p.add_argument("--remat", action="store_true")
+        p.add_argument("--remat", action="store_true",
+                       help="DEPRECATED: uniform full remat; prefer "
+                            "--remat-search")
+        p.add_argument("--remat-search", action="store_true")
+        p.add_argument("--remat-policies", type=str,
+                       default="none,dots,full")
+        p.add_argument("--fused-loss", type=str, default="auto",
+                       choices=("auto", "on", "off"))
+        p.add_argument("--fused-optimizer", type=str, default="auto",
+                       choices=("auto", "on", "off"))
         p.add_argument("--compgraph", dest="export_dot", type=str, default="")
         p.add_argument("--include-costs-dot-graph", action="store_true")
         p.add_argument("--serve", action="store_true")
@@ -399,6 +467,10 @@ class FFConfig:
             halt_on_nonfinite=args.halt_on_nonfinite,
             compute_dtype=args.compute_dtype,
             remat=args.remat,
+            remat_search=args.remat_search,
+            remat_policies=args.remat_policies,
+            fused_loss=args.fused_loss,
+            fused_optimizer=args.fused_optimizer,
             export_dot=args.export_dot,
             include_costs_dot_graph=args.include_costs_dot_graph,
             serve=args.serve,

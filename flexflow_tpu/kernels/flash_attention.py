@@ -25,7 +25,7 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-_BLOCK_CANDIDATES = (512, 256, 128)
+_BLOCK_CANDIDATES = (1024, 512, 256, 128)
 _NEG_INF = float("-inf")
 # k/v (fwd/dq) and q/do (dk/dv) are held fully in VMEM per (b, h) grid step;
 # cap their footprint well under the ~16MB VMEM budget so Mosaic never OOMs
@@ -39,11 +39,18 @@ _VMEM_SEQ_BYTES = 6 * 1024 * 1024
 # 256KB block per operand (q, do, dq accumulators all carry it); bf16 keeps
 # the full 512. 160KB leaves the d=64 behavior exactly as before.
 _VMEM_BLOCK_BYTES = 160 * 1024
+# narrow heads (d <= 64, the 54%-MFU case in BENCH_r05) get a larger
+# per-block budget: a 1024 x 64 f32 block is 256KB and three such operands
+# are still < 1MB of VMEM, while the doubled rows-per-grid-step halve the
+# k/v streaming overhead that starves the MXU at short blocks. Wider heads
+# keep the 160KB budget (d=128 behavior unchanged: f32 -> 256, bf16 -> 512).
+_VMEM_BLOCK_BYTES_NARROW = 256 * 1024
 
 
 def _blocks_for(depth: int, itemsize: int):
+    budget = _VMEM_BLOCK_BYTES_NARROW if depth <= 64 else _VMEM_BLOCK_BYTES
     ok = tuple(b for b in _BLOCK_CANDIDATES
-               if b * max(1, depth) * itemsize <= _VMEM_BLOCK_BYTES)
+               if b * max(1, depth) * itemsize <= budget)
     # always leave the smallest block available: a 128-row block at any
     # plausible head_dim fits VMEM; the budget only orders preferences
     return ok or _BLOCK_CANDIDATES[-1:]

@@ -113,6 +113,11 @@ class Strategy:
     # sub-mesh); this block says where the sequential splits fall
     # (parallel/pipeline.py executes them on disjoint device groups)
     pipeline: Optional[Dict] = None
+    # per-layer rematerialization policy of the strategy: None, or
+    # {layer_name: "dots"|"full"} for layers the memory-aware DP chose to
+    # recompute in the backward pass (layers absent keep policy "none");
+    # applied at lowering as per-layer jax.checkpoint wrappers
+    remat: Optional[Dict[str, str]] = None
 
     def input_pspec(self, tensor_name: str) -> PartitionSpec:
         if tensor_name not in self.input_shardings:
@@ -132,6 +137,8 @@ class Strategy:
         }
         if self.pipeline:
             d["pipeline"] = self.pipeline
+        if self.remat:
+            d["remat"] = self.remat
         return d
 
     def save(self, path: str):
@@ -146,6 +153,7 @@ class Strategy:
             mesh_axes=dict(d.get("mesh_axes", {})),
             name=d.get("name", "strategy"),
             pipeline=d.get("pipeline"),
+            remat=d.get("remat"),
         )
 
     @staticmethod

@@ -512,6 +512,45 @@ def pipeline_phase_times(stage_costs: Sequence[float]):
     return fwd, bwd
 
 
+# --------------------------------------------------------- remat costing
+# Per-layer rematerialization policies the DP searches over (ISSUE 12):
+# policy -> (recompute_frac, keep_frac).
+#   recompute_frac — extra time the backward pays, as a fraction of the
+#     op's 3x-roofline step cost. "full" re-runs the layer forward once
+#     (= c/3 of the fwd+bwd cost — the same recompute convention
+#     pipeline_phase_times charges its recompute-based backward slots);
+#     "dots" keeps matmul outputs and re-runs only the cheap elementwise
+#     tail (jax.checkpoint_policies.checkpoint_dots), ~a quarter of a
+#     forward.
+#   keep_frac — fraction of the layer's BACKWARD-stash residency that
+#     survives until the backward pass. The DP's live-activation
+#     accounting charges a forward value (mult 1) plus a backward stash
+#     (mult act_mult-1, normally 1): "none" keeps the whole stash,
+#     "dots" roughly half (dot outputs saved, elementwise recomputed),
+#     "full" none of it — only the layer INPUT (already charged as the
+#     producer's output) is saved.
+REMAT_POLICY_SPECS: Dict[str, Tuple[float, float]] = {
+    "none": (0.0, 1.0),
+    "dots": (1.0 / 12.0, 0.5),
+    "full": (1.0 / 3.0, 0.0),
+}
+
+
+def remat_recompute_time(op_time_s: float, policy: str) -> float:
+    """Extra backward-pass time a remat policy adds to one op: the
+    recompute fraction of its (3x-roofline) step cost."""
+    return REMAT_POLICY_SPECS[policy][0] * op_time_s
+
+
+def remat_act_mult(policy: str, act_mult: float) -> float:
+    """Effective live-bytes multiplier for a remat'd layer's outputs: the
+    forward value (1) plus the surviving fraction of the backward stash
+    (act_mult - 1). none: act_mult unchanged; full: 1 (value only);
+    dots: halfway. Inference (act_mult=1) is a fixed point — remat can't
+    save memory where no stash exists."""
+    return 1.0 + REMAT_POLICY_SPECS[policy][1] * (act_mult - 1.0)
+
+
 def pipeline_step_time(fwd_times: Sequence[float], bwd_times: Sequence[float],
                        boundary_bytes: Sequence[float], machine: MachineSpec,
                        schedule: str, num_micro: int) -> float:

@@ -86,6 +86,9 @@ class SearchResult:
     choices: Dict[str, Candidate]  # layer name -> chosen candidate
     cost: float                    # predicted step time (s)
     mem_bytes: int                 # predicted per-device memory high-water
+    # layer name -> chosen remat policy ("dots"/"full"; "none" omitted) —
+    # populated only when the DP searched remat_policies (ISSUE 12)
+    remat: Dict[str, str] = dataclasses.field(default_factory=dict)
 
 
 # ------------------------------------------------- tier-3 incremental DP
@@ -197,9 +200,20 @@ def _search_graph_impl(model, machine: MachineSpec, beam_width: int = 64,
                  opt_mem: "Optional[cm.OptMemSpec]" = None,
                  objective: str = "latency",
                  inference: bool = False,
+                 remat_policies: Optional[Sequence[str]] = None,
                  ) -> "SearchResult | List[SearchResult]":
     """cost_fn(layer, cand) -> seconds overrides the analytic op time
     (hook for the measured path, search/measure.py).
+
+    `remat_policies` promotes rematerialization to a PER-LAYER search
+    dimension (ISSUE 12): each compute candidate expands once per policy
+    in the set (cost_model.REMAT_POLICY_SPECS — none / dots / full), the
+    policy's recompute time is added to the step cost and its keep
+    fraction scales the layer outputs' live-activation multiplier, so
+    under a memory cap the DP trades HBM for FLOPs layer by layer instead
+    of being forced into ZeRO or pipelining. None / ("none",) (and any
+    inference search — no backward stash exists) reproduces the exact
+    pre-remat DP: same expansions, costs and memory.
 
     `objective` ("latency" | "throughput") selects the _score variant the
     beam ranks by — the serving search's latency-vs-throughput knob.
@@ -262,23 +276,41 @@ def _search_graph_impl(model, machine: MachineSpec, beam_width: int = 64,
     # inference holds no backward copies: forward value only (1x vs 2x)
     act_mult = 1 if inference else 2
 
-    def _live_act_bytes(frontier_map) -> int:
-        # 2x: forward value + gradient held for the backward pass
-        return sum(act_mult * cm.shard_bytes(specs[g], list(d), machine)
-                   for g, d in frontier_map.items())
+    # searched remat: "none" is always present at index 0 (passthrough
+    # candidates pin to it, and the search must be able to keep any layer
+    # unrematerialized). Inference has no backward stash to free.
+    policies: Tuple[str, ...] = tuple(dict.fromkeys(
+        ("none",) + tuple(remat_policies or ())))
+    if inference:
+        policies = ("none",)
+
+    def _live_act_bytes(frontier_map, mults=None) -> int:
+        # act_mult x: forward value + gradient held for the backward pass;
+        # outputs of remat'd layers carry a reduced per-guid multiplier
+        # (cost_model.remat_act_mult)
+        if not mults:
+            return sum(act_mult * cm.shard_bytes(specs[g], list(d), machine)
+                       for g, d in frontier_map.items())
+        return int(sum(
+            mults.get(g, act_mult) * cm.shard_bytes(specs[g], list(d),
+                                                    machine)
+            for g, d in frontier_map.items()))
 
     def score(c: float, m: int) -> float:
         return _score(c, m, mem_budget, objective)
 
-    # beam entries: frontier -> (cost, w_mem, act_high, trace)
+    # beam entries: frontier -> (cost, w_mem, act_high, trace, mults)
     # w_mem = cumulative persistent weight memory (params+grads+opt moments:
     # ALL of it is resident for the whole step, init allocates up front);
     # act_high = max over layers of live activation bytes. The reported
     # high-water is final_w_mem + act_high — weights from layers not yet
     # processed are still counted against an early activation peak.
+    # trace elements are (candidate_idx, policy_idx); mults maps a frontier
+    # guid to its effective activation multiplier when a remat policy
+    # reduced it (absent guid = act_mult).
     init_act = _live_act_bytes(dict(init_frontier))
-    beam: Dict[Tuple, Tuple[float, int, int, Tuple]] = {
-        init_frontier: (0.0, 0, init_act, ())}
+    beam: Dict[Tuple, Tuple[float, int, int, Tuple, Dict[int, float]]] = {
+        init_frontier: (0.0, 0, init_act, (), {})}
     cand_cache: Dict[str, List[Candidate]] = {}
 
     # tier-3: resume from the deepest matching prefix snapshot
@@ -299,7 +331,12 @@ def _search_graph_impl(model, machine: MachineSpec, beam_width: int = 64,
                 if any(g is None for g, _ in guids):
                     resumed = None
                     break
-                resumed[tuple(sorted(guids))] = entry
+                # entry mults were stored under canonical coords too —
+                # remap back to this graph's guids (all mult guids are
+                # frontier guids, so the same inv map covers them)
+                ec, ew, ea, et, emu = entry
+                mu = {inv[c]: m for c, m in emu}
+                resumed[tuple(sorted(guids))] = (ec, ew, ea, et, mu)
             if resumed:
                 beam = resumed
                 resume_li = li
@@ -327,15 +364,36 @@ def _search_graph_impl(model, machine: MachineSpec, beam_width: int = 64,
         cand_cache[layer.name] = cands
         if li <= resume_li:
             continue  # beam restored from snapshot; candidates only decode traces
-        new_beam: Dict[Tuple, Tuple[float, int, int, Tuple]] = {}
-        for frontier, (cost, w_mem, act_high, trace) in beam.items():
+        new_beam: Dict[Tuple, Tuple[float, int, int, Tuple, Dict]] = {}
+        for frontier, (cost, w_mem, act_high, trace, mults) in beam.items():
             fmap = dict(frontier)
-            fmap_act = _live_act_bytes(fmap)
+            fmap_act = _live_act_bytes(fmap, mults)
+
+            def commit(c, wm, out_dims, new_mults, ci, pi):
+                # peak while this layer runs: ALL its inputs (even those
+                # dying here) are live together with its outputs (out guids
+                # are new, so the two contributions are disjoint)
+                ah = max(act_high,
+                         fmap_act + _live_act_bytes(out_dims, new_mults))
+                # new frontier: drop dead tensors, add outputs
+                nf = {g: d for g, d in fmap.items()
+                      if last_use.get(g, -1) > li}
+                for o in layer.outputs:
+                    if last_use.get(o.guid, -1) > li or layer is layers[-1]:
+                        nf[o.guid] = out_dims[o.guid]
+                nm = {g: m for g, m in new_mults.items() if g in nf} \
+                    if new_mults else {}
+                key = tuple(sorted(nf.items()))
+                prev = new_beam.get(key)
+                if prev is None or score(c, wm + ah) < score(
+                        prev[0], prev[1] + prev[2]):
+                    new_beam[key] = (c, wm, ah, trace + ((ci, pi),), nm)
+
             for ci, cand in enumerate(cands):
-                SEARCH_STATS["expansions"] = SEARCH_STATS.get(
-                    "expansions", 0) + 1
-                c = cost
                 if cand.passthrough:
+                    SEARCH_STATS["expansions"] = SEARCH_STATS.get(
+                        "expansions", 0) + 1
+                    c = cost
                     # identity layout marker: adopt input-0's layout (minus
                     # drop_axis). When dropping the axis actually changes the
                     # layout (the input really was sharded over it), the
@@ -349,60 +407,74 @@ def _search_graph_impl(model, machine: MachineSpec, beam_width: int = 64,
                     if od != cur0:
                         c += cm.reshard_time(layer.inputs[0].spec,
                                              list(cur0), list(od), machine)
-                    wm = w_mem
-                    out_dims = {o.guid: od for o in layer.outputs}
-                else:
-                    # edge costs: reshard each input from its frontier layout
-                    feasible = True
-                    edge_comm = 0.0
-                    for ii, tin in enumerate(layer.inputs):
-                        cur = fmap.get(tin.guid)
-                        if cur is None:
-                            feasible = False
-                            break
-                        want = _freeze_dims(cand.in_dims[ii] if ii < len(cand.in_dims)
-                                            else [None] * tin.spec.ndim)
-                        edge_comm += cm.reshard_time(tin.spec, list(cur), list(want), machine)
-                    if not feasible:
+                    # passthrough outputs alias input-0: they inherit its
+                    # multiplier (a remat'd producer's saving propagates
+                    # through resharding markers), and "none" (index 0) is
+                    # the only policy — there is no compute to re-run
+                    nm = mults
+                    if mults and layer.inputs:
+                        m0 = mults.get(layer.inputs[0].guid)
+                        if m0 is not None:
+                            nm = dict(mults)
+                            for o in layer.outputs:
+                                nm[o.guid] = m0
+                    commit(c, w_mem, {o.guid: od for o in layer.outputs},
+                           nm, ci, 0)
+                    continue
+                SEARCH_STATS["expansions"] = SEARCH_STATS.get(
+                    "expansions", 0) + 1
+                # edge costs: reshard each input from its frontier layout
+                feasible = True
+                edge_comm = 0.0
+                for ii, tin in enumerate(layer.inputs):
+                    cur = fmap.get(tin.guid)
+                    if cur is None:
+                        feasible = False
+                        break
+                    want = _freeze_dims(cand.in_dims[ii] if ii < len(cand.in_dims)
+                                        else [None] * tin.spec.ndim)
+                    edge_comm += cm.reshard_time(tin.spec, list(cur), list(want), machine)
+                if not feasible:
+                    continue
+                total = cost_fn(layer, cand) if cost_fn else cand.op_time(layer, machine)
+                # compute/comm overlap (the event-driven-simulator gap,
+                # reference simulator.h:785-827, closed-form): XLA's
+                # async collectives hide input-edge + op-inherent
+                # collective time behind up to overlap_frac of the
+                # consumer's pure compute. Purely additive costing
+                # (overlap_frac=0) systematically over-prices strategies
+                # whose collectives ride behind the next op's matmuls.
+                op_comm = cand.extra_comm
+                if not inference:
+                    op_comm += cm.grad_sync_time(
+                        layer.weight_specs, cand.weight_dims, machine,
+                        _batch_axes_cached,
+                        zero=bool(opt_mem and opt_mem.zero_axes))
+                comp = max(0.0, total - op_comm)
+                base_c = cost + cm.overlapped_step_cost(
+                    comp, edge_comm + op_comm, machine)
+                wm = w_mem + cand.weight_mem_bytes(layer, machine, opt_mem)
+                out_dims = {
+                    o.guid: _freeze_dims(cand.out_dims[oi] if oi < len(cand.out_dims)
+                                         else [None] * o.spec.ndim)
+                    for oi, o in enumerate(layer.outputs)}
+                # the remat dimension: one expansion per policy — "none"
+                # replays the pre-remat DP exactly; "dots"/"full" pay the
+                # recompute fraction of THIS op's step cost and shrink the
+                # outputs' live multiplier (cost_model REMAT_POLICY_SPECS)
+                for pi, pol in enumerate(policies):
+                    if pi:  # the "none" expansion was counted above
+                        SEARCH_STATS["expansions"] = SEARCH_STATS.get(
+                            "expansions", 0) + 1
+                    if pol == "none":
+                        commit(base_c, wm, out_dims, mults, ci, pi)
                         continue
-                    total = cost_fn(layer, cand) if cost_fn else cand.op_time(layer, machine)
-                    # compute/comm overlap (the event-driven-simulator gap,
-                    # reference simulator.h:785-827, closed-form): XLA's
-                    # async collectives hide input-edge + op-inherent
-                    # collective time behind up to overlap_frac of the
-                    # consumer's pure compute. Purely additive costing
-                    # (overlap_frac=0) systematically over-prices strategies
-                    # whose collectives ride behind the next op's matmuls.
-                    op_comm = cand.extra_comm
-                    if not inference:
-                        op_comm += cm.grad_sync_time(
-                            layer.weight_specs, cand.weight_dims, machine,
-                            _batch_axes_cached,
-                            zero=bool(opt_mem and opt_mem.zero_axes))
-                    comp = max(0.0, total - op_comm)
-                    c += cm.overlapped_step_cost(comp, edge_comm + op_comm,
-                                                 machine)
-                    wm = w_mem + cand.weight_mem_bytes(layer, machine,
-                                                       opt_mem)
-                    out_dims = {
-                        o.guid: _freeze_dims(cand.out_dims[oi] if oi < len(cand.out_dims)
-                                             else [None] * o.spec.ndim)
-                        for oi, o in enumerate(layer.outputs)}
-                # peak while this layer runs: ALL its inputs (even those dying
-                # here) are live together with its outputs (out guids are new,
-                # so the two contributions are disjoint)
-                ah = max(act_high, fmap_act + _live_act_bytes(out_dims))
-                # new frontier: drop dead tensors, add outputs
-                nf = {g: d for g, d in fmap.items()
-                      if last_use.get(g, -1) > li}
-                for o in layer.outputs:
-                    if last_use.get(o.guid, -1) > li or layer is layers[-1]:
-                        nf[o.guid] = out_dims[o.guid]
-                key = tuple(sorted(nf.items()))
-                prev = new_beam.get(key)
-                if prev is None or score(c, wm + ah) < score(
-                        prev[0], prev[1] + prev[2]):
-                    new_beam[key] = (c, wm, ah, trace + (ci,))
+                    c = base_c + cm.remat_recompute_time(total, pol)
+                    pm = cm.remat_act_mult(pol, act_mult)
+                    nm = dict(mults)
+                    for o in layer.outputs:
+                        nm[o.guid] = pm
+                    commit(c, wm, out_dims, nm, ci, pi)
         # beam prune (ranked by cost + memory penalty; wm+ah understates the
         # final high-water by weights not yet placed, uniformly across states)
         if len(new_beam) > beam_width:
@@ -419,17 +491,25 @@ def _search_graph_impl(model, machine: MachineSpec, beam_width: int = 64,
             live = _live_coords(li, len(layers), pc_coords, last_use)
             # key=repr: coords mix ("in", i) and (topo_idx, slot) tuples,
             # which plain tuple ordering cannot compare
-            snap = {tuple(sorted(((pc_coords[g], d) for g, d in f),
-                                 key=repr)): e
-                    for f, e in beam.items()}
+            snap = {}
+            for f, e in beam.items():
+                ec, ew, ea, et, emu = e
+                cmu = tuple(sorted(((pc_coords[g], m)
+                                    for g, m in emu.items()), key=repr))
+                snap[tuple(sorted(((pc_coords[g], d) for g, d in f),
+                                  key=repr))] = (ec, ew, ea, et, cmu)
             prefix_cache.put((pc_keys[li], live), snap)
 
     def _to_result(entry) -> SearchResult:
-        cost, wm, ah, trace = entry
-        return SearchResult(
-            choices={layer.name: cand_cache[layer.name][ci]
-                     for layer, ci in zip(layers, trace)},
-            cost=cost, mem_bytes=wm + ah)
+        cost, wm, ah, trace, _mults = entry
+        choices: Dict[str, Candidate] = {}
+        remat: Dict[str, str] = {}
+        for layer, (ci, pi) in zip(layers, trace):
+            choices[layer.name] = cand_cache[layer.name][ci]
+            if policies[pi] != "none":
+                remat[layer.name] = policies[pi]
+        return SearchResult(choices=choices, cost=cost, mem_bytes=wm + ah,
+                            remat=remat)
 
     ranked = sorted(beam.values(),
                     key=lambda v: score(v[0], v[1] + v[2]))

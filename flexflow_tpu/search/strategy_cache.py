@@ -124,7 +124,15 @@ def knob_fingerprint(cfg) -> str:
              # search ranks by — but only the pipelined search reads it, so
              # plain compiles keep their cache hits across accum changes
              (getattr(cfg, "accum_steps", 1)
-              if getattr(cfg, "pipeline_stages", 1) > 1 else 1))
+              if getattr(cfg, "pipeline_stages", 1) > 1 else 1),
+             # remat knobs change both the searched space (per-layer policy
+             # dimension) and the artifact (Strategy.remat) — a strategy
+             # searched without the remat dimension must never serve a
+             # compile that asked for it, and vice versa
+             getattr(cfg, "remat", False),
+             getattr(cfg, "remat_search", False),
+             (getattr(cfg, "remat_policies", "none,dots,full")
+              if getattr(cfg, "remat_search", False) else ""))
     return hashlib.sha256(repr(knobs).encode()).hexdigest()[:16]
 
 

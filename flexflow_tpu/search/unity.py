@@ -77,6 +77,7 @@ def substitution_optimize(pcg: PCG, machine: MachineSpec,
                           enable_attribute: bool = True,
                           dp_cache: Optional[DPPrefixCache] = None,
                           opt_mem=None,
+                          remat_policies=None,
                           ) -> Tuple[PCG, SearchResult, UnityStats]:
     """Best-first search over xfer applications (base_optimize analog).
 
@@ -94,7 +95,7 @@ def substitution_optimize(pcg: PCG, machine: MachineSpec,
                             enable_parameter=enable_parameter,
                             enable_attribute=enable_attribute,
                             pins=g.pins, prefix_cache=dp_cache,
-                            opt_mem=opt_mem)
+                            opt_mem=opt_mem, remat_policies=remat_policies)
 
     r0 = cost(pcg)
     stats = UnityStats(baseline_cost=r0.cost, best_cost=r0.cost)
@@ -292,6 +293,12 @@ def strategy_from_pcg(pcg: PCG, machine: MachineSpec, result: SearchResult,
         sh = st.op_shardings.get(base.name)
         if sh and base_idx < len(sh.outputs):
             sh.outputs[base_idx] = dims
+    if result.remat:
+        rm = dict(st.remat or {})
+        rm.update({n: p for n, p in result.remat.items()
+                   if n in model_layer_names})
+        if rm:
+            st.remat = rm
     return st
 
 
@@ -329,6 +336,10 @@ def unity_optimize(model, machine: MachineSpec, cost_fn=None,
         stats_all.json_rules = report
     pcg = PCG.from_model(model)
     mem_budget = machine.hbm_bytes if cfg.memory_search else None
+    # searched remat (ISSUE 12): the per-layer policy set the DP expands
+    # over. None keeps the exact pre-remat search (same expansion counts).
+    remat_policies = (cfg.remat_policy_list()
+                      if getattr(cfg, "remat_search", False) else None)
     segments = _segment_pcgs(pcg, max(2, cfg.base_optimize_threshold), machine)
     # search_budget is a GLOBAL expansion budget: structurally identical
     # segments (GPT-2's repeated blocks — equal PCG canonical keys) are
@@ -362,7 +373,8 @@ def unity_optimize(model, machine: MachineSpec, cost_fn=None,
                             mem_budget=mem_budget, cost_fn=cost_fn,
                             enable_parameter=en_param,
                             enable_attribute=en_attr, pins=g.pins,
-                            prefix_cache=dp_cache, opt_mem=opt_mem)
+                            prefix_cache=dp_cache, opt_mem=opt_mem,
+                            remat_policies=remat_policies)
 
     def _sim_refine(g: PCG, r: SearchResult) -> SearchResult:
         """simulator_mode='taskgraph': the additive DP prunes, the
@@ -389,7 +401,8 @@ def unity_optimize(model, machine: MachineSpec, cost_fn=None,
                                  enable_parameter=en_param,
                                  enable_attribute=en_attr, pins=g.pins,
                                  topk=cfg.simulator_topk,
-                                 prefix_cache=dp_cache, opt_mem=opt_mem)
+                                 prefix_cache=dp_cache, opt_mem=opt_mem,
+                                 remat_policies=remat_policies)
         with tel.span("search/sim_rerank", cat="compile",
                       finalists=len(finalists)
                       if isinstance(finalists, list) else 1):
@@ -419,7 +432,8 @@ def unity_optimize(model, machine: MachineSpec, cost_fn=None,
                             mem_budget=mem_budget, cost_fn=cost_fn,
                             enable_parameter=en_param,
                             enable_attribute=en_attr, pins=pins,
-                            prefix_cache=dp_cache, opt_mem=opt_mem)
+                            prefix_cache=dp_cache, opt_mem=opt_mem,
+                            remat_policies=remat_policies)
                         best, refined_done = replayed, True
                     else:
                         best, best_r = replayed, _cost_pcg(replayed)
@@ -438,7 +452,8 @@ def unity_optimize(model, machine: MachineSpec, cost_fn=None,
                 alpha=cfg.search_alpha, beam_width=beam_width,
                 mem_budget=mem_budget, cost_fn=cost_fn,
                 enable_parameter=en_param, enable_attribute=en_attr,
-                dp_cache=dp_cache, opt_mem=opt_mem)
+                dp_cache=dp_cache, opt_mem=opt_mem,
+                remat_policies=remat_policies)
             budget_left = max(0, budget_left - stats.expansions)
             seg_memo[k] = (stats.best_path, stats.baseline_cost, None)
             stats_all.expansions += stats.expansions

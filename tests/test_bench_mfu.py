@@ -1,0 +1,40 @@
+"""tools/bench_mfu.py CI wiring (ISSUE 12 satellite): the --check smoke
+asserts the whole MFU acceptance chain — mixed per-layer searched remat
+with predicted AND live memory reduction at cost-model-bounded recompute
+overhead, kernel-parity on every fusion leg, and op_attribution rows for
+the fused twin — and the BENCH artifact parses into the history CLI's
+"mfu" family."""
+
+import sys
+
+
+sys.path.insert(0, "tools")
+
+
+def test_bench_mfu_check_smoke(devices):
+    import bench_mfu
+
+    assert bench_mfu.main(["--check"]) == 0
+
+
+def test_bench_history_recognizes_mfu_family(tmp_path):
+    """An mfu artifact without its headline metrics is a broken evidence
+    file: the family extractor must find them (and --check must fail on
+    an empty extraction — test_attribution covers that generic path)."""
+    import json
+
+    import bench_history
+
+    art = {"remat_pred_mem_reduction": 0.02, "remat_live_temp_reduction":
+           0.03, "fused_ce_max_diff": 1e-7, "step_ms_fused": 10.0,
+           "mfu_weighted_fused": 0.01, "hbm_peak_bytes": 1e6,
+           "legs_passed": 6}
+    (tmp_path / "BENCH_mfu.json").write_text(json.dumps(art))
+    recs = bench_history.scan(str(tmp_path))
+    assert len(recs) == 1 and recs[0]["family"] == "mfu"
+    names = [m for m, _ in recs[0]["metrics"]]
+    assert "legs_passed" in names and "step_ms_fused" in names
+    # the committed artifact itself parses with a full metric row set
+    recs = bench_history.scan()
+    mine = [r for r in recs if r.get("family") == "mfu"]
+    assert mine and len(mine[0]["metrics"]) == 7

@@ -144,6 +144,46 @@ def test_head_dim_128_parity(causal):
                                    atol=1e-3, rtol=1e-3)
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_head_dim_64_retuned_blocks_parity(causal):
+    """Satellite (ISSUE 12): narrow heads waste the depth-sized budget —
+    head_dim <= 64 gets its own VMEM budget so long sequences keep the
+    1024-row block (fewer grid steps, better MXU occupancy). The retune
+    must leave every depth>=128 pick and the d=64 short-seq picks alone,
+    and match the einsum reference in fwd AND grads at the new block."""
+    from flexflow_tpu.kernels.flash_attention import _pick_block
+
+    # the retuned pick: d=64 f32 at seq 1024 now keeps the 1024 block
+    assert _pick_block(1024, 64, 4) == 1024
+    # the d=128 pins of the round-5 retune still hold
+    assert _pick_block(512, 64, 4) == 512
+    assert _pick_block(512, 128, 4) == 256
+    assert _pick_block(512, 128, 2) == 512
+
+    rng = np.random.default_rng(6)
+    b, h, s, d = 1, 2, 1024, 64
+    q = jnp.asarray(rng.normal(size=(b, h, s, d)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(b, h, s, d)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(b, h, s, d)), jnp.float32)
+    scale = 1.0 / np.sqrt(d)
+    out = flash_attention(q, k, v, causal=causal)
+    ref = _reference(q, k, v, causal, scale)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=5e-5, rtol=5e-5)
+
+    def f_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=causal) ** 2)
+
+    def f_ref(q, k, v):
+        return jnp.sum(_reference(q, k, v, causal, scale) ** 2)
+
+    g_flash = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(g_flash, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   atol=2e-3, rtol=2e-3)
+
+
 def test_vmem_reject_falls_back_to_reference_path():
     """A shape past the VMEM-resident budget raises ValueError at TRACE
     time (the graceful Mosaic-reject precheck), and the MHA auto path

@@ -1,0 +1,164 @@
+"""Searched rematerialization (ISSUE 12 tentpole a): per-layer remat
+policies as a frontier-DP search dimension — under a memory cap the DP
+trades HBM for recompute FLOPs layer by layer, the winning policy rides
+the Strategy into lowering (per-layer jax.checkpoint) and the strategy
+cache, and policies=("none",) reproduces the pre-remat DP exactly."""
+
+import numpy as np
+import pytest
+
+from flexflow_tpu import FFConfig, FFModel, SGDOptimizer
+from flexflow_tpu.core.layer import Layer
+from flexflow_tpu.core.tensor import Tensor
+from flexflow_tpu.losses import LossType
+from flexflow_tpu.parallel.machine import MachineSpec
+from flexflow_tpu.search import cost_model as cm
+from flexflow_tpu.search.dp import (SEARCH_STATS, _score,
+                                    reset_search_stats, search_graph)
+
+V5E8 = MachineSpec(mesh_axes={"data": 2, "model": 4}, chip="v5e")
+
+
+def _chain(batch=8192, hidden=2048, layers=6):
+    """Activation-heavy dense chain: the live frontier dominates the
+    footprint, so a tight cap makes remat worth its recompute."""
+    m = FFModel(FFConfig(batch_size=batch))
+    x = m.create_tensor([batch, hidden], name="x")
+    h = x
+    for i in range(layers):
+        h = m.dense(h, hidden, activation="gelu", name=f"blk{i}")
+    m.dense(h, 256, name="head")
+    return m
+
+
+def test_cost_model_remat_helpers():
+    # keep fraction scales the live-activation multiplier between 1 (full
+    # recompute: forward value dropped) and act_mult (no remat)
+    assert cm.remat_act_mult("none", 2) == 2
+    assert cm.remat_act_mult("dots", 2) == 1.5
+    assert cm.remat_act_mult("full", 2) == 1.0
+    # recompute time is the policy's fraction of the op's step cost
+    assert cm.remat_recompute_time(3.0, "none") == 0.0
+    assert cm.remat_recompute_time(3.0, "full") == pytest.approx(1.0)
+    assert 0 < cm.remat_recompute_time(3.0, "dots") < \
+        cm.remat_recompute_time(3.0, "full")
+
+
+def test_dp_selects_per_layer_remat_under_memory_cap():
+    """The acceptance shape: under a tight cap the DP assigns remat to
+    SOME layers (not all-or-nothing), buys real predicted memory with
+    priced recompute, and scores better than the no-remat search."""
+    base = search_graph(_chain(), V5E8, beam_width=64)
+    assert base.remat == {}  # no policies searched -> none assigned
+    cap = base.mem_bytes * 0.4
+
+    r = search_graph(_chain(), V5E8, beam_width=64, mem_budget=cap,
+                     remat_policies=("dots", "full"))
+    r0 = search_graph(_chain(), V5E8, beam_width=64, mem_budget=cap)
+
+    n_layers = len(_chain().layers)
+    assert r.remat, "cap should force at least one layer into remat"
+    assert len(r.remat) < n_layers, "per-layer, not all-or-nothing"
+    assert set(r.remat.values()) <= {"dots", "full"}
+    # the remat trade: less memory, more (priced) compute, better score
+    assert r.mem_bytes < r0.mem_bytes
+    assert r.cost >= r0.cost
+    assert _score(r.cost, r.mem_bytes, cap) < _score(r0.cost, r0.mem_bytes,
+                                                     cap)
+    # recompute overhead stays within the cost model's own estimate for
+    # the chosen policies (nothing extra leaks into the step cost)
+    model = _chain()
+    layers = {l.name: l for l in model.layers}
+    est = sum(cm.remat_recompute_time(
+        r.choices[n].op_time(layers[n], V5E8), pol)
+        for n, pol in r.remat.items())
+    assert r.cost - r0.cost <= est * 1.001 + 1e-12
+
+
+def test_none_policy_reproduces_baseline_dp_exactly():
+    """policies=("none",) IS the pre-remat DP: identical cost, memory,
+    choices and expansion count (the search fast path's invariant)."""
+    reset_search_stats()
+    a = search_graph(_chain(), V5E8, beam_width=32)
+    exp_a = SEARCH_STATS["expansions"]
+    reset_search_stats()
+    b = search_graph(_chain(), V5E8, beam_width=32,
+                     remat_policies=("none",))
+    exp_b = SEARCH_STATS["expansions"]
+    assert exp_a == exp_b
+    assert a.cost == b.cost
+    assert a.mem_bytes == b.mem_bytes
+    assert {n: c.name for n, c in a.choices.items()} == \
+        {n: c.name for n, c in b.choices.items()}
+    assert b.remat == {}
+
+
+def test_inference_search_never_remats():
+    """A serving program has no backward stash to free: the policy set
+    collapses to ("none",) regardless of what the caller asks for."""
+    r = search_graph(_chain(batch=512, hidden=512, layers=3), V5E8,
+                     beam_width=16, inference=True,
+                     remat_policies=("dots", "full"))
+    assert r.remat == {}
+
+
+def test_strategy_remat_json_roundtrip():
+    from flexflow_tpu.parallel.sharding import Strategy
+
+    st = Strategy(name="s", mesh_axes={"data": 8},
+                  remat={"blk0": "dots", "blk1": "full"})
+    st2 = Strategy.from_json(st.to_json())
+    assert st2.remat == {"blk0": "dots", "blk1": "full"}
+    # absent block stays absent (old cache entries deserialize clean)
+    st3 = Strategy(name="s", mesh_axes={"data": 8})
+    assert "remat" not in st3.to_json()
+    assert Strategy.from_json(st3.to_json()).remat is None
+
+
+def _guid_reset():
+    """Consecutive builds in one process advance the layer/tensor guid
+    counters, which shifts every dropout stream (rng_for folds in the
+    guid) — parity comparisons must pin them."""
+    Layer._next_guid[0] = 100
+    Tensor._next_guid[0] = 1000
+
+
+def _fit_mlp(devices, remat: bool, epochs=2):
+    _guid_reset()
+    cfg = FFConfig(batch_size=16, only_data_parallel=True, remat=remat,
+                   seed=3)
+    m = FFModel(cfg)
+    x = m.create_tensor([16, 32], name="x")
+    h = m.dense(x, 64, activation="gelu", name="up")
+    h = m.dropout(h, rate=0.25, name="drop")
+    h = m.dense(h, 32, activation="relu", name="down")
+    m.dense(h, 8, name="head")
+    cmod = m.compile(SGDOptimizer(lr=0.05),
+                     LossType.SPARSE_CATEGORICAL_CROSSENTROPY, metrics=[])
+    cmod.init(seed=0)
+    rng = np.random.default_rng(0)
+    xs = rng.normal(size=(32, 32)).astype(np.float32)
+    ys = rng.integers(0, 8, size=(32,)).astype(np.int32)
+    hist = cmod.fit([xs], ys, epochs=epochs, verbose=False)
+    return cmod, [h["loss"] for h in hist]
+
+
+def test_remat_alias_bit_identical_loss(devices):
+    """--remat (deprecated alias) = uniform per-layer "full" policy. The
+    lowering wraps each layer in jax.checkpoint; recompute must be
+    BIT-identical to the stash — same ops, same dropout stream (rng_for
+    folds in the layer guid, deterministic under replay)."""
+    cm_base, base = _fit_mlp(devices, remat=False)
+    cm_remat, remat = _fit_mlp(devices, remat=True)
+    assert cm_base.strategy.remat in (None, {})
+    assert cm_remat.strategy.remat  # alias materialized as per-layer map
+    assert set(cm_remat.strategy.remat.values()) == {"full"}
+    assert "up" in cm_remat.strategy.remat
+    assert base == remat  # exact float equality, both epochs
+
+
+def test_contradictory_remat_flags_rejected():
+    with pytest.raises(ValueError, match="contradicts"):
+        FFConfig(batch_size=8, remat=True, remat_search=True)
+    with pytest.raises(ValueError, match="unknown remat policies"):
+        FFConfig(batch_size=8, remat_policies="none,banana")

@@ -291,3 +291,46 @@ def test_bench_search_check_smoke(tmp_path):
     finally:
         sys.path.remove(tools)
     assert rc == 0
+
+
+def test_warm_compile_restores_searched_remat_with_zero_expansions(tmp_path):
+    """ISSUE-12 cache contract: the knob fingerprint keys on the remat
+    knobs and the per-layer policy block rides the serialized strategy —
+    a warm compile at the same knobs restores the remat assignment with
+    ZERO DP expansions, and flipping --remat-search re-searches."""
+    from flexflow_tpu.parallel.machine import MachineSpec as MS
+
+    def chain(remat_search=True):
+        cfg = FFConfig(batch_size=8192, search_budget=8,
+                       memory_search=True, remat_search=remat_search,
+                       strategy_cache_dir=str(tmp_path))
+        m = FFModel(cfg)
+        x = m.create_tensor([8192, 2048], name="x")
+        h = x
+        for i in range(6):
+            h = m.dense(h, 2048, activation="gelu", name=f"blk{i}")
+        m.dense(h, 256, name="head")
+        return m
+
+    # hbm cap ~0.4x the unconstrained high-water: remat must be chosen
+    mach = MS(mesh_axes={"data": 2, "model": 4}, chip="v5e",
+              hbm_bytes=75e6)
+    st1 = graph_optimize(chain(), mach)
+    assert SEARCH_STATS["expansions"] > 0
+    assert st1._cache_info["event"] == "store"
+    assert st1.remat, "memory cap should force a remat assignment"
+    assert set(st1.remat.values()) <= {"dots", "full"}
+
+    reset_search_stats()
+    st2 = graph_optimize(chain(), mach)
+    assert st2._cache_info["event"] == "hit"
+    assert SEARCH_STATS["expansions"] == 0  # the headline: no DP at all
+    assert SEARCH_STATS["calls"] == 0
+    assert st2.remat == st1.remat
+
+    # knob change (search off) is a different cache key: fresh search,
+    # and the plain DP assigns no remat
+    reset_search_stats()
+    st3 = graph_optimize(chain(remat_search=False), mach)
+    assert SEARCH_STATS["expansions"] > 0
+    assert not st3.remat

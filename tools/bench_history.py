@@ -94,6 +94,13 @@ FAMILIES: Dict[str, Tuple[str, Callable[[Dict[str, Any]],
                    ("tokens_per_s_per_chip", "ttft_p99_s",
                     "per_token_p99_s")
                    if d.get(k) is not None]),
+    "mfu": (
+        r"^BENCH_mfu\.json$",
+        lambda d: [(k, float(d[k])) for k in
+                   ("remat_pred_mem_reduction", "remat_live_temp_reduction",
+                    "fused_ce_max_diff", "step_ms_fused",
+                    "mfu_weighted_fused", "hbm_peak_bytes", "legs_passed")
+                   if d.get(k) is not None]),
     "swap": (
         r"^BENCH_swap\.json$",
         lambda d: [(k, float(d[k])) for k in
