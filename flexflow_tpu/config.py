@@ -233,6 +233,23 @@ class FFConfig:
     serve_ttft_budget_ms: float = 0.0
     serve_queue_cap: int = 0
     serve_decode_timeout_ms: float = 0.0
+    # decode throughput (ISSUE 13): speculative decoding + quantized KV.
+    #   serve_draft_model   — checkpoint/model spec for the small DRAFT
+    #                         model compile_serving lowers through the same
+    #                         search ("" = no speculation); programmatic
+    #                         callers pass draft= directly
+    #   serve_spec_tokens   — tokens the draft proposes per slot per round
+    #                         before ONE batched target verify pass (0 =
+    #                         speculation off even with a draft attached)
+    #   kv_cache_dtype      — paged-KV storage dtype: "auto" follows
+    #                         compute_dtype (today's behavior), "bf16"
+    #                         forces bf16 pools, "int8" stores int8 pools
+    #                         with per-page-entry-per-head f32 scales —
+    #                         the search prices the smaller pools (memory
+    #                         cap loosens, decode bandwidth term drops)
+    serve_draft_model: str = ""
+    serve_spec_tokens: int = 0
+    kv_cache_dtype: str = "auto"
 
     REMAT_POLICY_NAMES = ("none", "dots", "full")
 
@@ -373,6 +390,10 @@ class FFConfig:
         p.add_argument("--serve-ttft-budget-ms", type=float, default=0.0)
         p.add_argument("--serve-queue-cap", type=int, default=0)
         p.add_argument("--serve-decode-timeout-ms", type=float, default=0.0)
+        p.add_argument("--serve-draft-model", type=str, default="")
+        p.add_argument("--serve-spec-tokens", type=int, default=0)
+        p.add_argument("--kv-cache-dtype", type=str, default="auto",
+                       choices=("auto", "bf16", "int8"))
         return p
 
     @staticmethod
@@ -482,4 +503,7 @@ class FFConfig:
             serve_ttft_budget_ms=args.serve_ttft_budget_ms,
             serve_queue_cap=args.serve_queue_cap,
             serve_decode_timeout_ms=args.serve_decode_timeout_ms,
+            serve_draft_model=args.serve_draft_model,
+            serve_spec_tokens=args.serve_spec_tokens,
+            kv_cache_dtype=args.kv_cache_dtype,
         )

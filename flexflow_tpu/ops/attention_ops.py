@@ -67,24 +67,33 @@ def _split_heads(x, heads):
 
 
 def _mha_decode_lower(layer: Layer, inputs, weights, ctx: LoweringCtx):
-    """Single-token decode step against the paged KV cache (serving path).
+    """Decode step(s) against the paged KV cache (serving path).
 
-    Inputs are [slots, 1, embed]; the cache lives in lowering state:
-      ctx.state[layer.name]    = {"k": [pages, page, h, d], "v": ...}
+    Inputs are [slots, s, embed] — s=1 for the plain decode program, s=K+1
+    for the speculative-verify program (one batched pass teacher-forcing
+    the K drafted tokens). The cache lives in lowering state:
+      ctx.state[layer.name]    = {"k": [pages, page, h, d], "v": ...,
+                                  optionally "k_scale"/"v_scale" for int8}
       ctx.state["serve/page_table"] = [slots, pages_per_slot] int32 page ids
       ctx.state["serve/pos"]        = [slots] int32 count of cached tokens
 
-    The new token's K/V is scattered into page pos//page_size at offset
-    pos%page_size, then attention runs over the gathered per-slot pages with
-    a per-slot length mask (positions <= pos). Inactive slots point every
-    page-table entry at the reserved scratch page 0 with pos 0, so their
-    writes land in scratch and their (garbage but finite) outputs are
-    ignored by the scheduler. Everything is a fixed-shape gather/scatter —
-    no resharding, no recompilation across steps."""
+    Token i's K/V is scattered into page (pos+i)//page_size at offset
+    (pos+i)%page_size (out-of-range positions route to the scratch page,
+    mirroring commit_prefill), then attention runs over the gathered
+    per-slot pages with the causal extent mask (query i attends cached
+    positions <= pos+i). A quantized cache (int8 pools + per-entry-per-head
+    scales) quantizes on append and dequantizes in the gather — fused into
+    the attention by the pallas dequant kernel when fusion is enabled,
+    einsum fallback otherwise. Inactive slots point every page-table entry
+    at the reserved scratch page 0 with pos 0, so their writes land in
+    scratch and their (garbage but finite) outputs are ignored by the
+    scheduler. Everything is a fixed-shape gather/scatter — no resharding,
+    no recompilation across steps."""
     q = inputs[0]
     p = layer.params
     heads = p["num_heads"]
     embed = p["embed_dim"]
+    hd = embed // heads
     dt = q.dtype
 
     def proj(x, w, b):
@@ -93,34 +102,75 @@ def _mha_decode_lower(layer: Layer, inputs, weights, ctx: LoweringCtx):
             y = y + weights[b].astype(dt)
         return y
 
-    qh = _split_heads(proj(inputs[0], "wq", "bq"), heads)  # (slots, 1, h, d)
+    qh = _split_heads(proj(inputs[0], "wq", "bq"), heads)  # (slots, s, h, d)
     kh = _split_heads(proj(inputs[1], "wk", "bk"), heads)
     vh = _split_heads(proj(inputs[2], "wv", "bv"), heads)
 
     cache = ctx.state[layer.name]
     k_pool, v_pool = cache["k"], cache["v"]
+    quantized = "k_scale" in cache
     pt = ctx.state["serve/page_table"]
     pos = ctx.state["serve/pos"]
     page = k_pool.shape[1]
-    b = q.shape[0]
+    b, s = q.shape[0], q.shape[1]
     rows = jnp.arange(b)
-    pidx = pt[rows, pos // page]
-    off = pos % page
-    k_pool = k_pool.at[pidx, off].set(kh[:, 0].astype(k_pool.dtype))
-    v_pool = v_pool.at[pidx, off].set(vh[:, 0].astype(v_pool.dtype))
-    ctx.new_state[layer.name] = {"k": k_pool, "v": v_pool}
+    t = pos[:, None] + jnp.arange(s)[None, :]      # (slots, s) write positions
+    pg = t // page
+    in_range = pg < pt.shape[1]
+    pageix = jnp.where(in_range,
+                       pt[rows[:, None], jnp.minimum(pg, pt.shape[1] - 1)], 0)
+    off = t % page
+    if quantized:
+        from flexflow_tpu.serving.kv_cache import kv_quantize
 
-    # gather each slot's pages: [slots, pages_per_slot, page, h, d]
-    K = k_pool[pt].reshape(b, -1, heads, embed // heads).astype(dt)
-    V = v_pool[pt].reshape(b, -1, heads, embed // heads).astype(dt)
-    scale = 1.0 / math.sqrt(embed // heads)
-    logits = jnp.einsum("bqhd,bkhd->bhqk", qh, K) * scale
-    # causal-by-construction: attend cached positions 0..pos (inclusive —
-    # position pos is the token just written)
-    keep = jnp.arange(K.shape[1])[None, None, None, :] <= pos[:, None, None, None]
-    logits = jnp.where(keep, logits, jnp.finfo(logits.dtype).min)
-    probs = jax.nn.softmax(logits, axis=-1)
-    out = jnp.einsum("bhqk,bkhd->bqhd", probs, V).reshape(b, 1, embed)
+        qk, ks = kv_quantize(kh)
+        qv, vs = kv_quantize(vh)
+        k_pool = k_pool.at[pageix, off].set(qk)
+        v_pool = v_pool.at[pageix, off].set(qv)
+        k_scale = cache["k_scale"].at[pageix, off].set(ks)
+        v_scale = cache["v_scale"].at[pageix, off].set(vs)
+        ctx.new_state[layer.name] = {"k": k_pool, "v": v_pool,
+                                     "k_scale": k_scale, "v_scale": v_scale}
+    else:
+        k_pool = k_pool.at[pageix, off].set(kh.astype(k_pool.dtype))
+        v_pool = v_pool.at[pageix, off].set(vh.astype(v_pool.dtype))
+        ctx.new_state[layer.name] = {"k": k_pool, "v": v_pool}
+
+    scale = 1.0 / math.sqrt(hd)
+    out = None
+    if quantized:
+        # gather the int8 context + scales: [slots, L, h, (d)]
+        Kq = k_pool[pt].reshape(b, -1, heads, hd)
+        Vq = v_pool[pt].reshape(b, -1, heads, hd)
+        Ks = k_scale[pt].reshape(b, -1, heads)
+        Vs = v_scale[pt].reshape(b, -1, heads)
+        if ctx.enable_fusion:
+            try:
+                from flexflow_tpu.kernels.dequant_attention import (
+                    dequant_decode_attention,
+                )
+
+                out = dequant_decode_attention(qh, Kq, Ks, Vq, Vs, pos,
+                                               scale=scale)
+            except Exception:
+                out = None  # einsum dequant fallback below
+        if out is None:
+            K = (Kq.astype(jnp.float32) * Ks[..., None]).astype(dt)
+            V = (Vq.astype(jnp.float32) * Vs[..., None]).astype(dt)
+    else:
+        # gather each slot's pages: [slots, pages_per_slot, page, h, d]
+        K = k_pool[pt].reshape(b, -1, heads, hd).astype(dt)
+        V = v_pool[pt].reshape(b, -1, heads, hd).astype(dt)
+    if out is None:
+        logits = jnp.einsum("bqhd,bkhd->bhqk", qh, K) * scale
+        # causal-by-construction: query token i (at position pos+i, just
+        # written) attends cached positions 0..pos+i inclusive
+        keep = (jnp.arange(K.shape[1])[None, None, None, :]
+                <= t[:, None, :, None])
+        logits = jnp.where(keep, logits, jnp.finfo(logits.dtype).min)
+        probs = jax.nn.softmax(logits, axis=-1)
+        out = jnp.einsum("bhqk,bkhd->bqhd", probs, V)
+    out = out.reshape(b, s, embed)
     y = out @ weights["wo"].astype(dt)
     if "bo" in weights:
         y = y + weights["bo"].astype(dt)

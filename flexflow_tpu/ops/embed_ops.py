@@ -36,7 +36,15 @@ def _emb_lower(layer: Layer, inputs, weights, ctx):
     # table arrives pre-cast to compute_dtype by build_forward's uniform policy
     table = weights["kernel"]
     aggr = layer.params.get("aggr", "none")
-    y = jnp.take(table, ids, axis=0)
+    # mode="clip": jnp.take's default ("fill") injects NaN for any
+    # out-of-range id, and one NaN entering a sharded program poisons every
+    # collective downstream. Serving feeds transiently-out-of-range position
+    # ids by design — the speculative verify window runs K tokens past the
+    # committed stream, so near a request's end `pos + K` can overrun the
+    # position table. Clamping keeps those overhang queries finite (their
+    # tokens are never committed; the scheduler truncates at max_new), and
+    # is a no-op for every valid id.
+    y = jnp.take(table, ids, axis=0, mode="clip")
     if aggr == "sum":
         y = jnp.sum(y, axis=-2)
     elif aggr == "avg":

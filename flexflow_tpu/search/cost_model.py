@@ -85,6 +85,10 @@ class KVCacheSpec:
     pages_per_slot: int
     page_size: int       # token positions per page
     itemsize: int = 4
+    # quantized pools (--kv-cache-dtype int8): bytes of the per-page-entry
+    # per-head scale factor stored NEXT TO each (page_size, heads) row of
+    # int8 values — 0 for unquantized caches, 4 (one f32) for int8
+    scale_itemsize: int = 0
 
     @property
     def padded_len(self) -> int:
@@ -97,9 +101,10 @@ class KVCacheSpec:
         return self.slots * self.pages_per_slot + 1
 
     def layer_bytes(self) -> int:
-        """K + V pool bytes for ONE attention layer (unsharded)."""
+        """K + V pool bytes for ONE attention layer (unsharded), including
+        the per-(page entry, head) scale arrays of a quantized pool."""
         return (2 * self.pool_pages * self.page_size * self.heads
-                * self.head_dim * self.itemsize)
+                * (self.head_dim * self.itemsize + self.scale_itemsize))
 
     def total_bytes(self) -> int:
         return self.layers * self.layer_bytes()
@@ -117,7 +122,8 @@ class KVCacheSpec:
 
     def fingerprint(self) -> tuple:
         return (self.layers, self.heads, self.head_dim, self.slots,
-                self.pages_per_slot, self.page_size, self.itemsize)
+                self.pages_per_slot, self.page_size, self.itemsize,
+                self.scale_itemsize)
 
 
 def zero_divisor(spec: TensorSpec, dims: Sequence[DimSharding],

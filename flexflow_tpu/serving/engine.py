@@ -76,16 +76,70 @@ def _wq_heads_axis(strategy, attn_layers):
     return None
 
 
+def _resolve_kv_dtype(cfg, kv_cache_dtype: Optional[str]):
+    """Resolve --kv-cache-dtype into (pool dtype, itemsize, scale_itemsize,
+    quantized). "auto" follows compute_dtype (the pre-quantization
+    behavior); "bf16" forces bf16 pools; "int8" stores int8 pools with
+    per-(page entry, head) f32 scales."""
+    choice = (kv_cache_dtype or getattr(cfg, "kv_cache_dtype", "auto")
+              or "auto").lower()
+    if choice == "int8":
+        return jnp.dtype(jnp.int8), 1, 4, True
+    if choice == "bf16":
+        return jnp.dtype(jnp.bfloat16), 2, 0, False
+    if choice != "auto":
+        raise ValueError(f"unknown kv_cache_dtype {choice!r} "
+                         "(choose auto, bf16, or int8)")
+    cdt = cfg.compute_dtype
+    dt = jnp.dtype(cdt) if cdt and cdt not in ("float32", "f32") \
+        else jnp.dtype(jnp.float32)
+    return dt, int(dt.itemsize), 0, False
+
+
+def _draft_from_spec(cfg, path: str, batch: int):
+    """Build the --serve-draft-model graph: `path` is a JSON file of
+    GPT2Config overrides (e.g. {"d_model": 64, "layers": 1, ...}) for a
+    small gpt2-family draft sharing the target's vocab/seq contract.
+    Programmatic callers pass `draft=` directly and skip this."""
+    import json as _json
+
+    from flexflow_tpu.core.model import FFModel
+    from flexflow_tpu.models.gpt2 import GPT2Config, build_gpt2
+
+    with open(path) as f:
+        spec = _json.load(f)
+    dm = FFModel(cfg)
+    build_gpt2(dm, GPT2Config(**spec), batch=batch)
+    return dm
+
+
 def compile_serving(model, max_batch_slots: Optional[int] = None,
                     max_decode_len: Optional[int] = None,
-                    kv_page_size: Optional[int] = None) -> "ServingCompiled":
+                    kv_page_size: Optional[int] = None,
+                    draft=None, spec_tokens: Optional[int] = None,
+                    kv_cache_dtype: Optional[str] = None
+                    ) -> "ServingCompiled":
     """Build the serving programs for a decoder `model` (inputs shaped
     `[batch, seq, ...]`). Knob precedence: explicit args > FFConfig flags
-    (--max-batch-slots / --max-decode-len / --kv-page-size) > defaults."""
+    (--max-batch-slots / --max-decode-len / --kv-page-size /
+    --serve-draft-model / --serve-spec-tokens / --kv-cache-dtype) >
+    defaults.
+
+    Speculative decoding: `draft` (an FFModel twin-shaped like the target,
+    or --serve-draft-model naming a GPT2Config JSON) is compiled through
+    this same function recursively — its own prefill/decode programs, its
+    own searched strategies, its own paged cache with the TARGET's slot/
+    page geometry — and a third VERIFY program (`[slots, K+1]` decode-mode
+    clone lowered with the searched decode strategy) batch-verifies the K
+    drafted tokens in one pass."""
     cfg = model.config
     slots = int(max_batch_slots or getattr(cfg, "max_batch_slots", 8) or 8)
     max_new = int(max_decode_len or getattr(cfg, "max_decode_len", 0) or 32)
     page = int(kv_page_size or getattr(cfg, "kv_page_size", 16) or 16)
+    spec_k = int(spec_tokens if spec_tokens is not None
+                 else getattr(cfg, "serve_spec_tokens", 0) or 0)
+    kv_dtype, kv_itemsize, kv_scale_itemsize, kv_quantized = \
+        _resolve_kv_dtype(cfg, kv_cache_dtype)
     attn_params = [l.params for l in model.layers
                    if l.op_type is OperatorType.MULTIHEAD_ATTENTION]
     if not attn_params:
@@ -94,8 +148,13 @@ def compile_serving(model, max_batch_slots: Optional[int] = None,
     heads = int(attn_params[0]["num_heads"])
     embed = int(attn_params[0]["embed_dim"])
     seq = int(model.input_tensors[0].spec.shape[1])
+    if draft is None and spec_k > 0 and getattr(cfg, "serve_draft_model", ""):
+        draft = _draft_from_spec(cfg, cfg.serve_draft_model,
+                                 int(model.input_tensors[0].spec.shape[0]))
     with tel.span("serve/compile_serving", cat="compile", slots=slots,
-                  max_decode_len=max_new, kv_page_size=page):
+                  max_decode_len=max_new, kv_page_size=page,
+                  spec_tokens=spec_k if draft is not None else 0,
+                  kv_dtype=str(kv_dtype)):
         machine = resolve_machine(cfg)
         mesh = build_mesh(machine)
         pre_model, attn = clone_for_serving(model, "prefill", slots)
@@ -103,7 +162,8 @@ def compile_serving(model, max_batch_slots: Optional[int] = None,
         kv_spec = cm.KVCacheSpec(
             layers=len(attn), heads=heads, head_dim=embed // heads,
             slots=slots, pages_per_slot=-(-(seq + max_new) // page),
-            page_size=page, itemsize=4)
+            page_size=page, itemsize=kv_itemsize,
+            scale_itemsize=kv_scale_itemsize)
         searched = (getattr(cfg, "search_budget", 0) > 0
                     and not cfg.only_data_parallel
                     and machine.num_devices > 1)
@@ -116,13 +176,38 @@ def compile_serving(model, max_batch_slots: Optional[int] = None,
             dec_st = data_parallel_strategy(dec_model, machine)
         _overlay_parallel_ops(pre_model, pre_st)
         _overlay_parallel_ops(dec_model, dec_st)
+        ver_model = None
+        draft_engine = None
+        if draft is not None and spec_k > 0:
+            dseq = int(draft.input_tensors[0].spec.shape[1])
+            if dseq != seq:
+                raise ValueError(
+                    f"draft model seq {dseq} != target seq {seq}: the "
+                    "scheduler prefills both from one prompt batch")
+            # the verify program reuses the SEARCHED decode strategy
+            # (op_shardings key on preserved layer names) — no extra
+            # search, no extra strategy-cache entry
+            ver_model, _ = clone_for_serving(model, "decode", slots,
+                                             decode_seq=spec_k + 1)
+            _overlay_parallel_ops(ver_model, dec_st)
+            draft_engine = compile_serving(
+                draft, max_batch_slots=slots, max_decode_len=max_new,
+                kv_page_size=page, spec_tokens=0,
+                kv_cache_dtype=kv_cache_dtype)
         log.info("compile_serving: mesh=%s slots=%d kv=%d pages x %d tok "
-                 "(%.1f MiB/device)", dict(machine.mesh_axes), slots,
+                 "(%.1f MiB/device, dtype %s)%s",
+                 dict(machine.mesh_axes), slots,
                  kv_spec.pool_pages, page,
                  kv_spec.per_device_bytes(
-                     attn_head_degree(dec_st, attn, machine)) / 2**20)
+                     attn_head_degree(dec_st, attn, machine)) / 2**20,
+                 kv_dtype,
+                 f" spec_tokens={spec_k}" if draft_engine else "")
         return ServingCompiled(model, machine, mesh, pre_model, dec_model,
-                               pre_st, dec_st, attn, kv_spec, max_new)
+                               pre_st, dec_st, attn, kv_spec, max_new,
+                               kv_dtype=kv_dtype, kv_quantized=kv_quantized,
+                               verify_model=ver_model,
+                               spec_tokens=spec_k if draft_engine else 0,
+                               draft=draft_engine)
 
 
 class ServingCompiled:
@@ -131,7 +216,8 @@ class ServingCompiled:
     def __init__(self, model, machine: MachineSpec, mesh, prefill_model,
                  decode_model, prefill_strategy, decode_strategy,
                  attn_layers: List[str], kv_spec: "cm.KVCacheSpec",
-                 max_decode_len: int):
+                 max_decode_len: int, kv_dtype=None, kv_quantized: bool = False,
+                 verify_model=None, spec_tokens: int = 0, draft=None):
         self.model = model
         self.cfg = model.config
         self.machine = machine
@@ -145,13 +231,20 @@ class ServingCompiled:
         self.max_decode_len = int(max_decode_len)
         self.slots = int(kv_spec.slots)
         self._watermarks = health.WatermarkTracker()
+        self.kv_quantized = bool(kv_quantized)
+        self.spec_tokens = int(spec_tokens)
+        self.draft: Optional["ServingCompiled"] = draft
+        self.verify_model = verify_model
 
-        cdt = self.cfg.compute_dtype
-        pool_dtype = jnp.dtype(cdt) if cdt and cdt not in ("float32", "f32") \
-            else jnp.float32
+        if kv_dtype is None:
+            cdt = self.cfg.compute_dtype
+            kv_dtype = jnp.dtype(cdt) \
+                if cdt and cdt not in ("float32", "f32") else jnp.float32
+        self.kv_dtype = jnp.dtype(kv_dtype)
         heads_axis = _wq_heads_axis(decode_strategy, self.attn_layers)
         self.kv = PagedKVCache(kv_spec, self.attn_layers, mesh,
-                               heads_axis=heads_axis, dtype=pool_dtype)
+                               heads_axis=heads_axis, dtype=self.kv_dtype,
+                               quantized=self.kv_quantized)
         deg = 1
         if self.kv.heads_axis is not None:
             axes = (self.kv.heads_axis,) if isinstance(self.kv.heads_axis, str) \
@@ -191,7 +284,38 @@ class ServingCompiled:
 
         self._prefill_jit = jax.jit(_prefill)
         self._decode_jit = jax.jit(_decode)
+        self._decode_fn = _decode
+        self._verify_jit = None
+        self._verify_fn = None
+        self._spec_jit = None
+        self._spec_src = None
+        if verify_model is not None and self.spec_tokens > 0:
+            ver_out = verify_model.layers[-1].outputs[:1]
+            ver_fwd = build_forward(verify_model.layers,
+                                    verify_model.input_tensors, ver_out, mesh,
+                                    decode_strategy,
+                                    seq_length=self.cfg.seq_length or None,
+                                    compute_dtype=self.cfg.compute_dtype,
+                                    enable_fusion=self.cfg.enable_fusion)
+            ver_steps = self.spec_tokens + 1
+
+            def _verify(params, state, inputs):
+                outs, ns = ver_fwd(params, state, inputs, False, rng0)
+                # the verify pass teacher-forces K+1 tokens, so active
+                # slots cached K+1 more entries; the scheduler re-publishes
+                # the COMMITTED extent (<= this) after acceptance
+                ns[POS_KEY] = state[POS_KEY] + ver_steps * state[
+                    ACTIVE_KEY].astype(state[POS_KEY].dtype)
+                return outs[0], ns
+
+            self._verify_jit = jax.jit(_verify)
+            self._verify_fn = _verify
         self.params: Optional[Dict[str, Any]] = None
+        if tel.enabled():
+            tel.event("serve/engine", cat="serve",
+                      kv_dtype=str(self.kv_dtype),
+                      kv_quantized=self.kv_quantized,
+                      spec_tokens=self.spec_tokens)
 
         # hot-swap state (ISSUE 11): watch root + retained version trees
         self.swap_stats = health.SwapStats()
@@ -465,6 +589,84 @@ class ServingCompiled:
         t0 = tel.now_us()
         out = self._decode_jit(params, state, list(input_arrays))
         tel.record("serve/decode_step", t0, cat="serve")
+        return out
+
+    def verify_step(self, params, state, input_arrays):
+        """One speculative-verify pass: the `[slots, K+1]` decode-mode
+        program teacher-forces the last committed token plus the K drafted
+        tokens and returns logits `[slots, K+1, vocab]` — K+1 next-token
+        distributions from ONE bandwidth-amortized weight stream. The
+        cache caches all K+1 entries; the scheduler rolls positions back
+        to the accepted extent afterwards."""
+        if self._verify_jit is None:
+            raise RuntimeError("verify_step: engine compiled without a "
+                               "draft (pass draft=/--serve-draft-model and "
+                               "spec_tokens>0)")
+        if not tel.enabled():
+            return self._verify_jit(params, state, list(input_arrays))
+        t0 = tel.now_us()
+        out = self._verify_jit(params, state, list(input_arrays))
+        tel.record("serve/decode_step", t0, cat="serve",
+                   verify=True, steps=self.spec_tokens + 1)
+        return out
+
+    def build_spec_program(self, step_inputs_fn):
+        """Fuse one whole speculative round — the K chained greedy draft
+        steps AND the batched verify pass — into ONE jitted dispatch:
+
+            (params, draft_params, state, draft_state, last[slots,1])
+                -> (t_pred[slots,K+1], ver_in[slots,K+1],
+                    new_state, new_draft_state)
+
+        Per-dispatch host overhead is what kills speculation on a fast
+        decode path: run unfused, a round pays K+1 program launches to
+        commit ~a*K+1 tokens, which can be SLOWER than plain decode's one
+        launch per token. Fused, the round is one launch regardless of K —
+        the draft chain's argmax feedback stays on device.
+
+        `step_inputs_fn(tokens, state) -> [input_arrays]` must be
+        jax-traceable (pure jnp on the token array and cache state, as
+        `gpt2_step_inputs` is); a host-side fn raises at trace time and
+        the scheduler falls back to the unfused round. The program is
+        cached per step_inputs_fn identity."""
+        if self.draft is None or self._verify_fn is None:
+            raise RuntimeError("build_spec_program requires an engine "
+                               "compiled with draft= and spec_tokens>0")
+        if self._spec_jit is not None and self._spec_src is step_inputs_fn:
+            return self._spec_jit
+        K = self.spec_tokens
+        draft_fn = self.draft._decode_fn
+        verify_fn = self._verify_fn
+
+        def _spec_round(params, dparams, state, dstate, last):
+            cur = last
+            drafts = []
+            for _ in range(K):  # unrolled: K is small and fixed
+                dlogits, dstate = draft_fn(dparams, dstate,
+                                           step_inputs_fn(cur, dstate))
+                cur = jnp.argmax(dlogits[:, -1, :],
+                                 axis=-1).astype(jnp.int32)[:, None]
+                drafts.append(cur)
+            ver_in = jnp.concatenate([last] + drafts, axis=1)
+            vlogits, state = verify_fn(params, state,
+                                       step_inputs_fn(ver_in, state))
+            t_pred = jnp.argmax(vlogits, axis=-1).astype(jnp.int32)
+            return t_pred, ver_in, state, dstate
+
+        self._spec_jit = jax.jit(_spec_round)
+        self._spec_src = step_inputs_fn
+        return self._spec_jit
+
+    def spec_round_step(self, params, draft_params, state, draft_state,
+                        last, step_inputs_fn):
+        """Dispatch one fused speculative round (see build_spec_program)."""
+        fn = self.build_spec_program(step_inputs_fn)
+        if not tel.enabled():
+            return fn(params, draft_params, state, draft_state, last)
+        t0 = tel.now_us()
+        out = fn(params, draft_params, state, draft_state, last)
+        tel.record("serve/decode_step", t0, cat="serve",
+                   spec_round=True, steps=self.spec_tokens + 1)
         return out
 
     # ---------------------------------------------------------- accounting

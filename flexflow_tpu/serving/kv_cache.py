@@ -42,6 +42,25 @@ POS_KEY = "serve/pos"
 ACTIVE_KEY = "serve/active"
 
 
+def kv_quantize(x):
+    """Symmetric per-(position, head) int8 quantization over head_dim:
+    `scale = max|x| / 127` along the last axis, values rounded into
+    [-127, 127]. Returns (int8 values, f32 scales) with the scales one
+    rank lower — the per-page-entry-per-head arrays the quantized pools
+    store next to the values. The scale floor keeps all-zero rows (fresh
+    pages, padding routed to scratch) exactly representable as zeros."""
+    x = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(x), axis=-1)
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(x / scale[..., None]), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def kv_dequantize(q, scale):
+    """Inverse of kv_quantize: f32 values from int8 + per-row scales."""
+    return q.astype(jnp.float32) * scale[..., None]
+
+
 class KVPoolExhausted(Exception):
     """`admit` could not allocate the requested pages: the free list is
     shorter than the request's prompt + decode budget. Deliberately NOT a
@@ -81,11 +100,22 @@ def _commit_prefill(cache_state, kv_state, slot_ids, lengths):
         valid = t[None, :] < lengths[:, None]
         pageix = jnp.where(valid, pageix, 0)      # padding -> scratch
         off = jnp.broadcast_to(t % page, pageix.shape)
-        new[name] = {
-            "k": pool_k.at[pageix, off].set(kh.astype(pool_k.dtype)),
-            "v": cache_state[name]["v"].at[pageix, off].set(
-                vh.astype(pool_k.dtype)),
-        }
+        if "k_scale" in cache_state[name]:
+            # quantized pools: scatter int8 values + per-(entry, head) scales
+            qk, ks = kv_quantize(kh)
+            qv, vs = kv_quantize(vh)
+            new[name] = {
+                "k": pool_k.at[pageix, off].set(qk),
+                "v": cache_state[name]["v"].at[pageix, off].set(qv),
+                "k_scale": cache_state[name]["k_scale"].at[pageix, off].set(ks),
+                "v_scale": cache_state[name]["v_scale"].at[pageix, off].set(vs),
+            }
+        else:
+            new[name] = {
+                "k": pool_k.at[pageix, off].set(kh.astype(pool_k.dtype)),
+                "v": cache_state[name]["v"].at[pageix, off].set(
+                    vh.astype(pool_k.dtype)),
+            }
     return new
 
 
@@ -94,12 +124,14 @@ class PagedKVCache:
 
     def __init__(self, spec: KVCacheSpec, attn_layers: List[str],
                  mesh: Optional[Mesh] = None, heads_axis=None,
-                 dtype=jnp.float32):
+                 dtype=jnp.float32, quantized: bool = False):
         self.spec = spec
         self.attn_layers = list(attn_layers)
         self.mesh = mesh
         self.heads_axis = None
+        self.quantized = bool(quantized)
         pool_pspec = PartitionSpec()
+        scale_pspec = PartitionSpec()
         if mesh is not None and heads_axis is not None:
             axes = (heads_axis,) if isinstance(heads_axis, str) \
                 else tuple(heads_axis)
@@ -109,19 +141,35 @@ class PagedKVCache:
             if all(a in mesh.shape for a in axes) and spec.heads % deg == 0:
                 self.heads_axis = heads_axis
                 pool_pspec = PartitionSpec(None, None, heads_axis, None)
+                scale_pspec = PartitionSpec(None, None, heads_axis)
         self._pool_sharding = (NamedSharding(mesh, pool_pspec)
                                if mesh is not None else None)
+        self._scale_sharding = (NamedSharding(mesh, scale_pspec)
+                                if mesh is not None else None)
         self._repl = (NamedSharding(mesh, PartitionSpec())
                       if mesh is not None else None)
         shape = (spec.pool_pages, spec.page_size, spec.heads, spec.head_dim)
 
         def pool():
-            z = jnp.zeros(shape, dtype)
+            z = jnp.zeros(shape, jnp.int8 if self.quantized else dtype)
             return (jax.device_put(z, self._pool_sharding)
                     if self._pool_sharding is not None else z)
 
-        self.state: Dict = {n: {"k": pool(), "v": pool()}
-                            for n in self.attn_layers}
+        def scales():
+            # per-(page entry, head) f32 scales, sharded like the pools'
+            # heads dim so the quantized cache needs no resharding either
+            z = jnp.zeros(shape[:3], jnp.float32)
+            return (jax.device_put(z, self._scale_sharding)
+                    if self._scale_sharding is not None else z)
+
+        def layer_state():
+            st = {"k": pool(), "v": pool()}
+            if self.quantized:
+                st["k_scale"] = scales()
+                st["v_scale"] = scales()
+            return st
+
+        self.state: Dict = {n: layer_state() for n in self.attn_layers}
         # host mirrors (authoritative at scheduler sync points)
         self._table = np.zeros((spec.slots, spec.pages_per_slot), np.int32)
         self._pos = np.zeros((spec.slots,), np.int32)
@@ -217,7 +265,9 @@ class PagedKVCache:
         dev = jax.devices()[0]
         total = 0
         for n in self.attn_layers:
-            for leaf in (self.state[n]["k"], self.state[n]["v"]):
+            # every leaf of the layer's cache state — values AND, for a
+            # quantized cache, the per-(entry, head) scale arrays
+            for leaf in self.state[n].values():
                 shards = getattr(leaf, "addressable_shards", None)
                 if shards is None:
                     total += int(leaf.nbytes)

@@ -71,12 +71,15 @@ def _serving_params(layer: Layer, kind: str) -> dict:
     return p
 
 
-def clone_for_serving(model, kind: str, slots: int) -> Tuple[FFModel, List[str]]:
+def clone_for_serving(model, kind: str, slots: int,
+                      decode_seq: int = 1) -> Tuple[FFModel, List[str]]:
     """Replay `model`'s graph into a fresh FFModel shaped for serving.
 
     Inputs follow the decoder contract `[batch, seq, ...]`: the batch dim
-    becomes `slots` and, for kind="decode", the seq dim becomes 1. Weight
-    specs depend only on feature dims, so every layer re-infers cleanly and
+    becomes `slots` and, for kind="decode", the seq dim becomes `decode_seq`
+    (1 for the plain decode program; K+1 for the speculative-verify program
+    that teacher-forces K drafted tokens in one batched pass). Weight specs
+    depend only on feature dims, so every layer re-infers cleanly and
     params transfer by (layer name, weight name).
 
     Returns (serving_model, attention_layer_names) — the latter is the set
@@ -93,7 +96,7 @@ def clone_for_serving(model, kind: str, slots: int) -> Tuple[FFModel, List[str]]
         if s and s[0] == orig_batch:
             s[0] = slots
         if kind == "decode" and len(s) > 1:
-            s[1] = 1
+            s[1] = int(decode_seq)
         return tuple(s)
 
     sm = FFModel(model.config)

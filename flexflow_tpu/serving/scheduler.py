@@ -105,8 +105,14 @@ def gpt2_prompt_inputs(ids: np.ndarray, lengths: np.ndarray) -> List[np.ndarray]
 
 def gpt2_step_inputs(tokens, state) -> List[Any]:
     """gpt2 decode inputs: next token ids + the device-side positions (the
-    index each slot's token is written at — no host sync to build them)."""
-    return [tokens, state[POS_KEY][:, None]]
+    index each slot's token is written at — no host sync to build them).
+    Generalizes to multi-token steps (`tokens` shaped [slots, s] for the
+    speculative-verify pass): token i of a slot sits at position pos+i."""
+    pos = state[POS_KEY][:, None]
+    s = int(tokens.shape[1])
+    if s > 1:
+        pos = pos + jnp.arange(s, dtype=state[POS_KEY].dtype)[None, :]
+    return [tokens, pos]
 
 
 def _urgency(r: Request):
@@ -146,10 +152,38 @@ class ContinuousBatchingScheduler:
         self.completed: List[Request] = []
         self.shed: List[Request] = []
         self.failed: List[Request] = []
+        # speculative decoding: when the engine carries a draft, every
+        # round drafts K tokens and verifies them in one target pass; the
+        # draft's paged cache mirrors every admit/advance/evict
+        self.spec_tokens = int(getattr(engine, "spec_tokens", 0) or 0)
+        self.draft = getattr(engine, "draft", None)
+        self._spec = self.spec_tokens > 0 and self.draft is not None
+        if self._spec and self.draft.params is None:
+            raise ValueError(
+                "speculative scheduler: draft engine has no params (call "
+                "engine.draft.init() or engine.draft.load_params first)")
+        self._spec_fused = None
+        if self._spec:
+            try:
+                # one dispatch per round (draft chain + verify fused);
+                # requires a jax-traceable step_inputs_fn — probe with an
+                # abstract trace so a host-side fn falls back cleanly here
+                # instead of blowing up mid-serve
+                fn = engine.build_spec_program(step_inputs_fn)
+                jax.eval_shape(fn, params, self.draft.params, self.kv.state,
+                               self.draft.kv.state,
+                               jax.ShapeDtypeStruct((self.slots, 1),
+                                                    jnp.int32))
+                self._spec_fused = fn
+            except Exception:  # noqa: BLE001 — untraceable inputs fn
+                self._spec_fused = None
+        self._accept_ema = 0.0
         self.stats: Dict[str, int] = {
             "shed_queue_full": 0, "shed_ttft_budget": 0, "shed_deadline": 0,
             "shed_prompt_too_long": 0, "failed": 0, "evicted_wedged": 0,
-            "decode_timeouts": 0, "overdecode_tokens": 0, "swaps": 0}
+            "decode_timeouts": 0, "overdecode_tokens": 0, "swaps": 0,
+            "spec_rounds": 0, "spec_drafted_tokens": 0,
+            "spec_accepted_tokens": 0}
         self._ema_serve_ms = 0.0  # EMA of prefill wall (the shed estimator)
         # per-decode-step wall seconds at materialization granularity —
         # the per-token latency samples the bench quantiles
@@ -226,6 +260,9 @@ class ContinuousBatchingScheduler:
         req = active.pop(slot)
         self.kv.evict(slot)
         self.kv.push()
+        if self._spec:
+            self.draft.kv.evict(slot)
+            self.draft.kv.push()
         self.stats["evicted_wedged"] += 1
         tel.event("serve/slot_evicted", cat="serve", rid=req.rid, slot=slot,
                   outcome=outcome, tokens=len(req.tokens))
@@ -248,7 +285,11 @@ class ContinuousBatchingScheduler:
             if self.prefill_chunk_tokens and batch and \
                     chunk_used + len(req.prompt) > self.prefill_chunk_tokens:
                 break  # chunked admission: the rest joins the next wave
-            need = len(req.prompt) + req.max_new_tokens + self.dispatch_ahead
+            # speculation slack: a verify pass caches up to K entries past
+            # the committed extent, so the page reservation grows by K —
+            # rollback must never need pages the admit didn't grant
+            need = (len(req.prompt) + req.max_new_tokens
+                    + self.dispatch_ahead + self.spec_tokens)
             if not self.kv.can_admit(need):
                 break  # page backpressure: keep queued
             slot = free[0]
@@ -264,6 +305,12 @@ class ContinuousBatchingScheduler:
                 waiting.pop(i)
                 self._fail(req, "failed", now_s, e)
                 continue
+            if self._spec:
+                try:  # mirror the reservation in the draft's cache
+                    self.draft.kv.admit(slot, len(req.prompt), need)
+                except KVPoolExhausted:
+                    self.kv.evict(slot)
+                    break
             free.pop(0)
             req.slot = slot
             chunk_used += len(req.prompt)
@@ -271,6 +318,8 @@ class ContinuousBatchingScheduler:
         if not batch:
             return False
         self.kv.push()
+        if self._spec:
+            self.draft.kv.push()
         ids = np.zeros((self.slots, self.seq), np.int32)
         lengths = np.zeros((self.slots,), np.int32)
         for req in batch:
@@ -287,11 +336,34 @@ class ContinuousBatchingScheduler:
         except Exception as e:  # noqa: BLE001 — permanent prefill fault:
             for req in batch:   # fail ONLY the batch being admitted
                 self.kv.evict(req.slot)
+                if self._spec:
+                    self.draft.kv.evict(req.slot)
                 self._fail(req, "failed", self._now(), e)
             self.kv.push()
+            if self._spec:
+                self.draft.kv.push()
             return False
         self.kv.commit_prefill(kv_state,
                                np.arange(self.slots, dtype=np.int32), lengths)
+        if self._spec:
+            # the draft prefills the SAME prompt batch into its own cache;
+            # positions stay pairwise consistent with the target from here
+            try:
+                _dlg, dkv_state = run_resilient(
+                    "serve/prefill",
+                    lambda: self.draft.prefill(
+                        self.draft.params, self.prompt_inputs_fn(ids, lengths)),
+                    policy=self.retry_policy)
+            except Exception as e:  # noqa: BLE001
+                for req in batch:
+                    self.kv.evict(req.slot)
+                    self.draft.kv.evict(req.slot)
+                    self._fail(req, "failed", self._now(), e)
+                self.kv.push()
+                self.draft.kv.push()
+                return False
+            self.draft.kv.commit_prefill(
+                dkv_state, np.arange(self.slots, dtype=np.int32), lengths)
         self.prefills += 1
         lg = np.asarray(logits)  # sync: TTFT is a real materialization
         t_first = time.perf_counter()
@@ -314,6 +386,8 @@ class ContinuousBatchingScheduler:
         req.outcome = "done"
         req.finish_s = now_s
         self.kv.evict(req.slot)
+        if self._spec:
+            self.draft.kv.evict(req.slot)
         self.completed.append(req)
         tel.event("serve/request_done", cat="serve", rid=req.rid,
                   tokens=len(req.tokens), ttft_s=req.ttft_s,
@@ -384,6 +458,107 @@ class ContinuousBatchingScheduler:
             self._evict_wedged(active, "timeout", self._now(), None)
         return mats[-1].copy()
 
+    # --------------------------------------------------------- speculation
+    def _spec_round(self, active: Dict[int, Request],
+                    next_host: np.ndarray) -> np.ndarray:
+        """One speculative round: K chained greedy draft steps, ONE
+        batched target verify pass over `[last, d1..dK]`, then the
+        longest-accepted-prefix commit. Every committed token is the
+        verify program's argmax (the mismatch slot commits the target's
+        correction token), so greedy streams are bitwise identical to
+        non-speculative decode. Full acceptance caps the commit at K —
+        the draft never cached d_K's K/V, so committing the K+1'th
+        (bonus) token would start the next round with a draft-cache hole.
+
+        Device work and materializations all happen before any host
+        mutation, so a retried round (transient decode fault) replays
+        cleanly off the unchanged host mirrors."""
+        K = self.spec_tokens
+        t0 = time.perf_counter()
+        dstate = self.draft.kv.state
+        tstate = self.kv.state
+        last = jnp.asarray(next_host)
+        if self._spec_fused is not None:
+            # the whole round is ONE program launch (see
+            # engine.build_spec_program) — the draft chain's argmax
+            # feedback never leaves the device
+            t_pred_dev, ver_in, tstate, dstate = self.engine.spec_round_step(
+                self.params, self.draft.params, tstate, dstate, last,
+                self.step_inputs_fn)
+        else:
+            # unfused fallback (untraceable step_inputs_fn): K+1 launches
+            cur = last
+            drafts = []
+            for _ in range(K):
+                dlogits, dstate = self.draft.decode_step(
+                    self.draft.params, dstate,
+                    self.step_inputs_fn(cur, dstate))
+                cur = jnp.argmax(dlogits[:, -1, :], axis=-1).astype(
+                    jnp.int32)[:, None]
+                drafts.append(cur)
+            ver_in = jnp.concatenate([last] + drafts, axis=1)  # [slots, K+1]
+            vlogits, tstate = self.engine.verify_step(
+                self.params, tstate, self.step_inputs_fn(ver_in, tstate))
+            t_pred_dev = jnp.argmax(vlogits, axis=-1).astype(jnp.int32)
+        t_pred = np.asarray(t_pred_dev)
+        drafted = np.asarray(ver_in)[:, 1:]                  # [slots, K]
+        wall = time.perf_counter() - t0
+        # ---- host commit: nothing below touches the device programs ----
+        match = drafted == t_pred[:, :-1]                    # [slots, K]
+        adv = np.zeros((self.slots,), np.int32)
+        out = next_host.copy()
+        finished: List[int] = []
+        round_accept = 0
+        max_commit = 1
+        for slot, req in active.items():
+            m = match[slot]
+            j = K if m.all() else int(m.argmin())  # accepted draft tokens
+            ncommit = min(j + 1, K)
+            committed = [int(t) for t in t_pred[slot, :ncommit]]
+            prev = len(req.tokens)
+            req.tokens.extend(committed)
+            round_accept += j
+            if self._truncate(req):
+                kept = max(0, len(req.tokens) - prev)
+                adv[slot] = kept
+                self.stats["overdecode_tokens"] += ncommit - kept
+                finished.append(slot)
+            else:
+                adv[slot] = ncommit
+                out[slot, 0] = committed[-1]
+            max_commit = max(max_commit, ncommit)
+        for kv, st in ((self.kv, tstate), (self.draft.kv, dstate)):
+            kv.adopt(st)
+            kv.sync_after(0, advances=adv)
+            kv.push()  # re-publish the COMMITTED extent: the device-side
+            #            speculative advance (K for draft, K+1 for the
+            #            verify pass) rolls back to what acceptance kept
+        for slot in finished:
+            self._finish(active.pop(slot), self._now())
+        round_drafted = K * max(1, len(finished) + len(active))
+        self.stats["spec_rounds"] += 1
+        self.stats["spec_drafted_tokens"] += round_drafted
+        self.stats["spec_accepted_tokens"] += round_accept
+        rate = round_accept / round_drafted
+        self._accept_ema = (rate if self.stats["spec_rounds"] == 1
+                            else 0.9 * self._accept_ema + 0.1 * rate)
+        tel.counter("serve/spec_drafted_tokens",
+                    self.stats["spec_drafted_tokens"], cat="serve")
+        tel.counter("serve/spec_accepted_tokens",
+                    self.stats["spec_accepted_tokens"], cat="serve")
+        tel.counter("serve/spec_accept_rate", self._accept_ema, cat="serve")
+        per_tok = wall / max_commit
+        self.step_times.extend([per_tok] * max_commit)
+        self.decode_steps += K + 1
+        if self.decode_timeout_ms and active and \
+                1e3 * wall / (K + 1) > self.decode_timeout_ms:
+            self.stats["decode_timeouts"] += 1
+            tel.event("serve/decode_timeout", cat="serve",
+                      per_step_ms=1e3 * wall / (K + 1),
+                      budget_ms=self.decode_timeout_ms)
+            self._evict_wedged(active, "timeout", self._now(), None)
+        return out
+
     def _now(self) -> float:
         return time.perf_counter() - self._t0
 
@@ -440,6 +615,21 @@ class ContinuousBatchingScheduler:
                     wait = max(0.0, queue[0].arrival_s - self._now())
                     time.sleep(min(wait, 0.05) if self.engine.watching
                                else wait)
+                continue
+            if self._spec:
+                # speculative rounds are self-contained (draft chain +
+                # verify + host commit) — no dispatch-ahead window, every
+                # round is a sync point, so poll_swap stays safe above
+                try:
+                    next_host = run_resilient(
+                        "serve/decode_step",
+                        lambda nh=next_host: self._spec_round(active, nh),
+                        policy=self.retry_policy)
+                except Exception as e:  # noqa: BLE001 — permanent fault
+                    if active:
+                        self._evict_wedged(active, "failed", self._now(), e)
+                state = self.kv.state
+                next_dev = jnp.asarray(next_host)
                 continue
             inputs = self.step_inputs_fn(next_dev, state)
             try:

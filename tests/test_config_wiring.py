@@ -221,6 +221,33 @@ def test_serving_resilience_flags_wired():
         assert flag in vf, flag
 
 
+def test_spec_kv_flags_wired():
+    """The ISSUE-13 decode-throughput knobs flow parse_args -> FFConfig via
+    build_parser only: draft-model JSON path, speculation depth, and the
+    KV-cache dtype (constrained to the engine's supported set). All default
+    OFF/auto — an engine built without them is byte-identical to before."""
+    import pytest
+
+    from flexflow_tpu.config import FFConfig as Cfg
+
+    cfg = Cfg.parse_args(["--serve-draft-model", "/tmp/draft.json",
+                          "--serve-spec-tokens", "4",
+                          "--kv-cache-dtype", "int8"])
+    assert cfg.serve_draft_model == "/tmp/draft.json"
+    assert cfg.serve_spec_tokens == 4
+    assert cfg.kv_cache_dtype == "int8"
+    d = Cfg()
+    assert d.serve_draft_model == ""     # no draft -> plain decode
+    assert d.serve_spec_tokens == 0      # 0 = speculation off
+    assert d.kv_cache_dtype == "auto"    # auto = follow compute dtype
+    with pytest.raises(SystemExit):
+        Cfg.parse_args(["--kv-cache-dtype", "fp4"])
+    vf = Cfg.launcher_value_flags()
+    for flag in ("--serve-draft-model", "--serve-spec-tokens",
+                 "--kv-cache-dtype"):
+        assert flag in vf, flag
+
+
 def test_health_flags_wired():
     """The ISSUE-9 health knobs flow parse_args -> FFConfig via
     build_parser only (launcher value-flag set derives automatically):

@@ -92,7 +92,16 @@ FAMILIES: Dict[str, Tuple[str, Callable[[Dict[str, Any]],
         r"^BENCH_serve\.json$",
         lambda d: [(k, float(d[k])) for k in
                    ("tokens_per_s_per_chip", "ttft_p99_s",
-                    "per_token_p99_s")
+                    "per_token_p99_s", "spec_accept_rate",
+                    "kv_itemsize")
+                   if d.get(k) is not None]),
+    "spec": (
+        r"^BENCH_spec\.json$",
+        lambda d: [(k, float(d[k])) for k in
+                   ("spec_speedup_best", "spec_accept_rate_best",
+                    "spec_tokens_best", "int8_tokens_per_s_per_chip",
+                    "int8_kv_shard_degree", "bf16_kv_shard_degree",
+                    "legs_passed")
                    if d.get(k) is not None]),
     "mfu": (
         r"^BENCH_mfu\.json$",

@@ -47,7 +47,7 @@ def _quantile(xs, q):
     return float(np.quantile(np.asarray(xs, np.float64), q))
 
 
-def _build_engine(check: bool):
+def _build_engine(check: bool, kv_cache_dtype: str = "auto"):
     import jax
 
     from flexflow_tpu import FFConfig, FFModel
@@ -58,7 +58,8 @@ def _build_engine(check: bool):
     mesh = ({"data": 2, "model": n_dev // 2} if n_dev % 2 == 0 and n_dev > 1
             else {"data": max(1, n_dev)})
     cfg = FFConfig(search_budget=16, mesh_shape=mesh, log_level="warning",
-                   max_batch_slots=4, kv_page_size=4)
+                   max_batch_slots=4, kv_page_size=4,
+                   kv_cache_dtype=kv_cache_dtype)
     gc = (GPT2Config(vocab=256, seq=16, d_model=64, heads=2, layers=1,
                      dropout=0.0) if check else
           GPT2Config(vocab=512, seq=32, d_model=128, heads=4, layers=2,
@@ -112,6 +113,10 @@ def _run_leg(eng, gc, n_dev, rate, n_requests, seed):
         "per_token_p99_s": _quantile(sched.step_times, 0.99),
         "decode_steps": sched.decode_steps,
         "prefill_batches": sched.prefills,
+        "spec_accept_rate": (
+            round(sched.stats["spec_accepted_tokens"]
+                  / sched.stats["spec_drafted_tokens"], 4)
+            if sched.stats.get("spec_drafted_tokens") else None),
         "all_complete": all(len(r.tokens) == r.max_new_tokens for r in done),
     }
 
@@ -123,6 +128,9 @@ def main(argv=None) -> int:
     p.add_argument("--requests", type=int, default=24)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--out", default="", help="also write the JSON here")
+    p.add_argument("--kv-cache-dtype", default="auto",
+                   choices=("auto", "bf16", "int8"),
+                   help="KV-cache storage dtype for the bench engine")
     p.add_argument("--check", action="store_true",
                    help="CI smoke: tiny twin, assert completion + ordered "
                         "finite quantiles + KV memory accounting")
@@ -130,7 +138,7 @@ def main(argv=None) -> int:
     if args.check:
         args.requests = min(args.requests, 8)
 
-    eng, gc, n_dev = _build_engine(args.check)
+    eng, gc, n_dev = _build_engine(args.check, args.kv_cache_dtype)
     ms = eng.memory_stats()
     hr = eng.health_report()["watermarks"]
     legs = []
@@ -149,10 +157,18 @@ def main(argv=None) -> int:
         "memory": ms,
         "watermark": hr,
         "legs": legs,
+        # ISSUE 13: KV storage + speculation provenance on the artifact
+        "kv_cache_dtype": str(eng.kv_dtype),
+        "kv_itemsize": eng.kv_spec.itemsize,
+        "kv_scale_itemsize": eng.kv_spec.scale_itemsize,
+        "spec_tokens": eng.spec_tokens,
         # headline metrics (bench_history "serve" family)
         "tokens_per_s_per_chip": max(l["tokens_per_s_per_chip"] for l in legs),
         "ttft_p99_s": legs[-1]["ttft_p99_s"],
         "per_token_p99_s": legs[-1]["per_token_p99_s"],
+        "spec_accept_rate": next(
+            (l["spec_accept_rate"] for l in reversed(legs)
+             if l["spec_accept_rate"] is not None), None),
     }
     print(json.dumps(report, indent=1))
     if args.out:

@@ -64,7 +64,10 @@ def gather(events: List[Dict[str, Any]]) -> Dict[str, Any]:
              "ts_first": None, "ts_last": None,
              # ISSUE 11: hot-swap + degradation stream
              "swap_ms": [], "active_version": None, "rollbacks": 0,
-             "shed": 0, "failed": 0, "evicted": 0, "retries": 0}
+             "shed": 0, "failed": 0, "evicted": 0, "retries": 0,
+             # ISSUE 13: speculative decoding + KV quantization stream
+             "spec_drafted": 0, "spec_accepted": 0, "spec_accept_ema": None,
+             "kv_dtype": None, "spec_tokens": 0}
     for ev in events:
         name = ev.get("name", "")
         args = ev.get("args") or {}
@@ -105,6 +108,15 @@ def gather(events: List[Dict[str, Any]]) -> Dict[str, Any]:
             serve["active_slots"] = args.get("value")
         elif name == "serve/queue_depth":
             serve["queue_depth"] = args.get("value")
+        elif name == "serve/spec_drafted_tokens":
+            serve["spec_drafted"] = int(args.get("value") or 0)
+        elif name == "serve/spec_accepted_tokens":
+            serve["spec_accepted"] = int(args.get("value") or 0)
+        elif name == "serve/spec_accept_rate":
+            serve["spec_accept_ema"] = args.get("value")
+        elif name == "serve/engine":
+            serve["kv_dtype"] = args.get("kv_dtype", serve["kv_dtype"])
+            serve["spec_tokens"] = int(args.get("spec_tokens") or 0)
         elif name == "health/nonfinite":
             sent["nonfinite"] += 1
             last_nonfinite = args
@@ -181,6 +193,15 @@ def _serve_stats(serve: Dict[str, Any]) -> Optional[Dict[str, Any]]:
                         if serve.get("swap_ms") else None),
         "active_version": serve.get("active_version"),
         "rollbacks": serve.get("rollbacks", 0),
+        "spec_drafted": serve.get("spec_drafted", 0),
+        "spec_accepted": serve.get("spec_accepted", 0),
+        "spec_accept_rate": (
+            serve.get("spec_accept_ema") if serve.get("spec_accept_ema")
+            is not None else
+            (serve.get("spec_accepted", 0) / serve["spec_drafted"]
+             if serve.get("spec_drafted") else None)),
+        "spec_tokens": serve.get("spec_tokens", 0),
+        "kv_dtype": serve.get("kv_dtype"),
     }
 
 
@@ -238,6 +259,14 @@ def render(state: Dict[str, Any]) -> List[str]:
                 f"         params v{f(sv['active_version'], '%g')}  "
                 f"swaps={sv['swaps']} rollbacks={sv['rollbacks']} "
                 f"swap p99 {f(sv['swap_p99_ms'], '%.1fms')}")
+        if sv["spec_drafted"] or sv["kv_dtype"]:
+            rate = sv["spec_accept_rate"]
+            lines.append(
+                f"         spec K={sv['spec_tokens']} "
+                f"drafted={sv['spec_drafted']} "
+                f"accepted={sv['spec_accepted']} "
+                f"accept_ema={f(rate, '%.2f')}  "
+                f"kv_dtype={sv['kv_dtype'] or '-'}")
     sent = state["sentinels"]
     bad = sent["nonfinite"] or state["halts"]
     status = "FATAL" if bad else (
@@ -343,6 +372,24 @@ def prom_export(state: Dict[str, Any], path: str) -> None:
             gauge("flexflow_serve_swap_p99_seconds",
                   sv["swap_p99_ms"] / 1e3,
                   "p99 hot-swap latency (read+validate+place+flip)")
+        gauge("flexflow_serve_spec_drafted_tokens_total",
+              float(sv["spec_drafted"]),
+              "Draft tokens proposed by the speculative decoder")
+        gauge("flexflow_serve_spec_accepted_tokens_total",
+              float(sv["spec_accepted"]),
+              "Draft tokens accepted by the target verify pass")
+        if sv["spec_accept_rate"] is not None:
+            gauge("flexflow_serve_spec_accept_rate",
+                  float(sv["spec_accept_rate"]),
+                  "EMA of the per-round draft acceptance rate")
+        if sv["kv_dtype"] is not None:
+            # dtype rides as a label on a constant-1 gauge (the textfile
+            # collector has no string metrics)
+            g.append("# HELP flexflow_serve_kv_cache_dtype_info "
+                     "KV-cache storage dtype of the serving engine")
+            g.append("# TYPE flexflow_serve_kv_cache_dtype_info gauge")
+            g.append('flexflow_serve_kv_cache_dtype_info{dtype="%s"} 1'
+                     % sv["kv_dtype"])
     tmp = path + ".tmp"
     with open(tmp, "w") as f:
         f.write("\n".join(g) + "\n")
