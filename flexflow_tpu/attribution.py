@@ -171,7 +171,9 @@ def build_report(items: List[Dict[str, Any]],
                  source: str = "auto",
                  measure_repeats: int = 3,
                  measure_warmup: int = 1,
-                 emit: Optional[bool] = None) -> Dict[str, Any]:
+                 emit: Optional[bool] = None,
+                 inference: bool = False,
+                 tag: Optional[str] = None) -> Dict[str, Any]:
     """Assemble the attribution report.
 
     items: one dict per placed op — {"layer", "cand", "machine",
@@ -189,6 +191,12 @@ def build_report(items: List[Dict[str, Any]],
     "measure".
     emit: write op/attr + op/drift_topk telemetry events (default: when
     the telemetry sink is enabled) — this is what grows the span corpus.
+    inference: forward-pass-only regime (serving prefill/decode — ISSUE
+    14 satellite): measures each op's jitted FORWARD at shard-local
+    shapes and prices the roofline's forward leg, so the corpus learns
+    the bandwidth-bound decode regime training rows never show it.
+    tag: emitted as the op/attr events' "source" (e.g. "serve_decode"),
+    so corpus rows record which execution regime measured them.
     """
     from flexflow_tpu.search.measure import MeasuredCost
 
@@ -225,9 +233,24 @@ def build_report(items: List[Dict[str, Any]],
     for it in items:
         layer, cand, machine = it["layer"], it["cand"], it["machine"]
         roof = cmod.op_roofline(layer, cand, machine)
+        if inference:
+            # forward leg only: op_roofline prices fwd+bwd (the 3x-flops /
+            # 2x-bytes training convention), a serving step runs forward
+            roof = dict(roof)
+            t_flop = roof["t_flop_s"] / 3.0
+            t_mem = roof["t_mem_s"] / 2.0
+            roof["roofline_s"] = max(t_flop, t_mem)
+            roof["device_flops"] = roof["device_flops"] / 3.0
+            roof["hbm_bytes"] = roof["hbm_bytes"] / 2.0
+            roof["bound"] = "bandwidth" if t_mem > t_flop else "compute"
+            roof["mfu_ceiling"] = (
+                roof["device_flops"] / (roof["roofline_s"] * machine.flops)
+                if roof["roofline_s"] > 0 else 0.0)
         if trace_totals is not None:
             # whole-run device us; normalized to per-update seconds below
             measured = trace_totals.get(layer.name, 0.0) * 1e-6
+        elif inference:
+            measured = mc_for(machine).op_time_fwd(layer, cand) * mult
         else:
             measured = mc_for(machine).op_time(layer, cand) * mult
         predicted = it.get("predicted_s")
@@ -292,7 +315,7 @@ def build_report(items: List[Dict[str, Any]],
                      "mfu", "mfu_ceiling", "key")}
             if r["stage"] is not None:
                 args["stage"] = r["stage"]
-            args["source"] = used_source
+            args["source"] = tag or used_source
             args["features"] = r["features"]
             tel.event(OP_EVENT, cat="op", **args)
         td = report["top_drift"]

@@ -904,6 +904,20 @@ class CompiledModel:
             self.op_attribution(print_table=verbose)
         if will_report:
             self.profile_report()
+        # self-calibration (ISSUE 14): --auto-refit closes the drift loop —
+        # fold this run's telemetry through span_dataset into a refreshed
+        # learned cost model. Runs AFTER op_attribution so the refit sees
+        # THIS fit's op/attr rows, and on every profiled fit (not only a
+        # tripped drift warn) so the corpus keeps growing; the model file's
+        # content hash re-keys the strategy cache either way.
+        if getattr(self.cfg, "auto_refit", False):
+            from flexflow_tpu.search.learned_cost import auto_refit
+
+            info = auto_refit(self.cfg)
+            if info is not None and verbose:
+                print(f"[refit] cost model <- {info['rows']} corpus rows "
+                      f"({len(info['kinds'])} op kinds) -> {info['path']} "
+                      f"[{info['fingerprint']}]")
         return history
 
     def _fit_end_report(self, verbose: bool) -> None:

@@ -54,9 +54,18 @@ class FFConfig:
     # LogicalTaskgraphBasedSimulator, simulator.h:785-827): "additive"
     # trusts the frontier DP's closed-form costing; "taskgraph" replays the
     # top finalists on per-stream timelines and picks by makespan
+    # "learned" (ISSUE 14) prices the SAME search with the per-op-kind
+    # ridge from search/learned_cost.py (trained by
+    # tools/refit_cost_model.py); no model file -> falls back to additive
     simulator_mode: str = "additive"
     simulator_segment_size: int = 16 * 1024 * 1024  # model.cc:3493
     simulator_topk: int = 4
+    # learned cost model file; "" = $FF_COST_MODEL_PATH or
+    # ~/.cache/flexflow_tpu/cost_model.json
+    cost_model_path: str = ""
+    # refit the learned model from this run's telemetry at fit end
+    # (tools/refit_cost_model.py — the drift report's self-calibration)
+    auto_refit: bool = False
     # machine model (cost model) description file; "" = default v5p-like model
     machine_model_file: str = ""
     # training-loop pipeline (compiler/compile.py _fit_epochs): the fit loop
@@ -332,10 +341,12 @@ class FFConfig:
                        default=True)
         p.add_argument("--strategy-cache-dir", type=str, default="")
         p.add_argument("--simulator-mode", type=str, default="additive",
-                       choices=("additive", "taskgraph"))
+                       choices=("additive", "learned", "taskgraph"))
         p.add_argument("--simulator-segment-size", type=int,
                        default=16 * 1024 * 1024)
         p.add_argument("--simulator-topk", type=int, default=4)
+        p.add_argument("--cost-model-path", type=str, default="")
+        p.add_argument("--auto-refit", action="store_true")
         p.add_argument("--simulator-trace", type=str, default="")
         p.add_argument("--machine-model-file", type=str, default="")
         p.add_argument("--sync-every", type=int, default=0)
@@ -460,6 +471,8 @@ class FFConfig:
             simulator_mode=args.simulator_mode,
             simulator_segment_size=args.simulator_segment_size,
             simulator_topk=args.simulator_topk,
+            cost_model_path=args.cost_model_path,
+            auto_refit=args.auto_refit,
             simulator_trace=args.simulator_trace,
             machine_model_file=args.machine_model_file,
             sync_every=args.sync_every,

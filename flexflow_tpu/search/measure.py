@@ -180,6 +180,13 @@ class MeasuredCost:
             layer.weight_specs, cand.weight_dims, self.machine,
             _batch_axes(self.machine))
 
+    def op_time_fwd(self, layer: "Layer", cand: "Candidate") -> float:
+        """Forward-pass-only total (serving attribution — ISSUE 14): the
+        measured fwd leg plus the candidate's inherent collectives; no
+        backward, no grad sync (inference never runs either)."""
+        fwd, _bwd = self.op_times(layer, cand)
+        return fwd + cand.extra_comm
+
     @staticmethod
     def _host_sync(out):
         """block_until_ready alone is NOT a reliable barrier under the axon

@@ -712,3 +712,62 @@ class ServingCompiled:
                 self._watermarks.report(
                     self.memory_stats()["predicted_total_bytes"]),
                 "serving": self.swap_stats.report()}
+
+    def op_attribution(self, kind: str = "both",
+                       step_time_s: Optional[float] = None,
+                       prefill_step_time_s: Optional[float] = None,
+                       print_table: bool = False, top: int = 0
+                       ) -> Dict[str, Any]:
+        """Serving-regime per-op attribution (ISSUE 14 satellite): the
+        serving face of CompiledModel.op_attribution. One report per
+        program (prefill / decode), each row featurized against the
+        placement that actually compiled and priced by the SAME serving
+        cost functions the search ranked with — so the op/attr events the
+        telemetry sink collects teach the span corpus (and through it the
+        learned cost model) the bandwidth-bound seq=1 decode regime that
+        training fits never exercise. step_time_s normalizes decode rows
+        (the scheduler passes its median per-token wall), prefill_step_
+        time_s the prefill rows (the shed estimator's EMA)."""
+        from flexflow_tpu import attribution
+        from flexflow_tpu.search.candidates import compiled_candidate
+        from flexflow_tpu.serving.program import (_decode_cost_fn,
+                                                  _prefill_cost_fn)
+
+        programs = []
+        if kind in ("both", "prefill"):
+            programs.append(("serve_prefill", self.prefill_model,
+                             self.prefill_strategy,
+                             _prefill_cost_fn(self.machine),
+                             prefill_step_time_s))
+        if kind in ("both", "decode"):
+            programs.append(("serve_decode", self.decode_model,
+                             self.decode_strategy,
+                             _decode_cost_fn(self.machine,
+                                             self.kv_spec.layer_bytes()),
+                             step_time_s))
+        reports: Dict[str, Any] = {}
+        for tag, smodel, strategy, cost, t_step in programs:
+            batch_sizes = {t.spec.shape[0] for t in smodel.input_tensors
+                           if t.spec.ndim > 0}
+            items = []
+            for layer in topo_order(smodel.layers):
+                cand = compiled_candidate(layer, strategy, self.machine,
+                                          batch_sizes)
+                if cand.passthrough:
+                    continue
+                try:
+                    predicted = float(cost(layer, cand))
+                except Exception:
+                    predicted = None
+                items.append({"layer": layer, "cand": cand,
+                              "machine": self.machine,
+                              "predicted_s": predicted, "stage": None})
+            report = attribution.build_report(
+                items, step_time_s=t_step, mult=1, source="measure",
+                inference=True, tag=tag)
+            if print_table:
+                print(f"[{tag}]")
+                for line in attribution.format_report(report, top=top):
+                    print(line)
+            reports[tag] = report
+        return reports

@@ -655,4 +655,18 @@ class ContinuousBatchingScheduler:
                 logits[:, -1, :], axis=-1).astype(jnp.int32)[:, None]
             window_toks.append(next_dev)
             self.decode_steps += 1
+        if getattr(self.engine.cfg, "profile_ops", False) and tel.enabled():
+            # --profile-ops (ISSUE 14 satellite): featurize this run's
+            # prefill + decode placements into op/attr corpus rows, with
+            # the run's REAL wall times as the step normalizers — the
+            # learned cost model's only window into the bandwidth-bound
+            # seq=1 decode regime training fits never exercise
+            try:
+                self.engine.op_attribution(
+                    step_time_s=(float(np.median(self.step_times))
+                                 if self.step_times else None),
+                    prefill_step_time_s=(self._ema_serve_ms / 1e3
+                                         if self._ema_serve_ms else None))
+            except Exception:  # noqa: BLE001 — never fail a served batch
+                pass
         return self.completed

@@ -44,7 +44,8 @@ _T0 = time.perf_counter()  # process epoch all ts are relative to
 
 # cost-model drift guardrail: measured/predicted step-time ratios beyond
 # this factor (either direction) flag the calibration as stale — the
-# `[drift]` report sections point at tools/calibrate.py
+# `[drift]` report sections point at tools/refit_cost_model.py (the
+# self-calibrating loop; `--auto-refit` runs it at fit end)
 DRIFT_WARN_RATIO = 3.0
 
 
@@ -391,8 +392,9 @@ def drift_stats(predicted_s: Optional[float],
     warn only trips (past DRIFT_WARN_RATIO in either direction) when at
     least one post-compilation window exists — a 1-epoch fit reports the
     ratio for the record but can't distinguish drift from compile cost.
-    A tripped warn is the cue to re-run tools/calibrate.py and refresh
-    the measured-cost store."""
+    A tripped warn is the cue to refit the learned cost model from this
+    run's telemetry (tools/refit_cost_model.py; `--auto-refit` does it
+    automatically at fit end)."""
     ws = [(int(n), float(t)) for n, t in windows if n > 0 and t > 0.0]
     steady = ws[1:] if len(ws) >= 2 else ws
     measured = statistics.median(t / n for n, t in steady) if steady \
@@ -446,6 +448,6 @@ def format_drift(d: Dict[str, Any]) -> List[str]:
         lines.append(
             f"[drift] WARNING: measured/predicted ratio {d['ratio']:.2f}x "
             f"outside [1/{DRIFT_WARN_RATIO:g}, {DRIFT_WARN_RATIO:g}] — the "
-            "calibrated cost model has drifted; re-run tools/calibrate.py "
-            "to refresh the measured-cost store")
+            "cost model has drifted; refit from this run's telemetry with "
+            "tools/refit_cost_model.py (or pass --auto-refit)")
     return lines

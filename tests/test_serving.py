@@ -330,6 +330,40 @@ def test_bench_serve_check_smoke(devices, capsys):
     assert "CHECK PASS" in capsys.readouterr().out
 
 
+def test_serve_profile_ops_emits_corpus_rows(gpt2_serve, rng, tmp_path):
+    """--profile-ops on a serving engine (ISSUE 14 satellite): a served
+    batch featurizes its prefill + decode placements into op/attr corpus
+    rows priced by the serving search's OWN cost fns — the learned cost
+    model's only window into the bandwidth-bound seq=1 decode regime."""
+    from flexflow_tpu import telemetry as tel
+    from flexflow_tpu.attribution import OP_EVENT
+
+    eng, gc = gpt2_serve
+    tdir = str(tmp_path / "tel")
+    tel.configure(tdir)
+    old = eng.cfg.profile_ops
+    eng.cfg.profile_ops = True
+    try:
+        reqs = [Request(rid=i, prompt=list(rng.integers(1, gc.vocab, size=3)),
+                        max_new_tokens=3, arrival_s=0.0) for i in range(2)]
+        sched = ContinuousBatchingScheduler(eng, eng.params,
+                                            gpt2_prompt_inputs,
+                                            gpt2_step_inputs)
+        sched.run(reqs)
+    finally:
+        eng.cfg.profile_ops = old
+        tel.shutdown()
+    rows = [e.get("args") or {} for e in tel.read_events(tdir)
+            if e.get("name") == OP_EVENT]
+    srcs = {a.get("source") for a in rows}
+    assert {"serve_prefill", "serve_decode"} <= srcs, srcs
+    # every row is a full corpus row: featurized, with the serving
+    # regime's own predicted price
+    assert all(isinstance(a.get("features"), dict) for a in rows)
+    dec = [a for a in rows if a.get("source") == "serve_decode"]
+    assert any((a.get("predicted_s") or 0) > 0 for a in dec)
+
+
 def test_serve_telemetry_stream(gpt2_serve, rng, tmp_path):
     """serve/prefill + serve/decode_step spans, queue/slot counters and
     per-request lifecycle events flow through the PR 5 sink and feed the

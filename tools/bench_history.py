@@ -110,6 +110,13 @@ FAMILIES: Dict[str, Tuple[str, Callable[[Dict[str, Any]],
                     "fused_ce_max_diff", "step_ms_fused",
                     "mfu_weighted_fused", "hbm_peak_bytes", "legs_passed")
                    if d.get(k) is not None]),
+    "learned": (
+        r"^BENCH_learned\.json$",
+        lambda d: [(k, float(d[k])) for k in
+                   ("mape_learned", "mape_additive", "cold_compile_s",
+                    "dp_expansions", "expansions_saved_frac",
+                    "prune_speedup", "coverage", "legs_passed")
+                   if d.get(k) is not None]),
     "swap": (
         r"^BENCH_swap\.json$",
         lambda d: [(k, float(d[k])) for k in

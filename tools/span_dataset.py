@@ -16,13 +16,19 @@ every profiled fit grows the dataset.
 Usage:
     python tools/span_dataset.py <telemetry-dir-or-file> [--out corpus.jsonl]
                                  [--merge existing.jsonl]
+    python tools/span_dataset.py --stats <corpus.jsonl-or-telemetry-dir>
     python tools/span_dataset.py --check   # CI smoke: profiled fit -> corpus
 
 Row schema (one JSON object per line):
-  {"key": str, "features": {...2008.01040 featurization...},
+  {"schema_version": int, "key": str,
+   "features": {...2008.01040 featurization...},
    "machine": str, "n": int, "measured_s": {"mean", "p50", "min", "max"},
    "attributed_s_mean": float, "predicted_s": float, "roofline_s": float,
    "mfu_mean": float, "bound": str, "sources": [..]}
+
+`--stats` prints corpus health (rows, machines, op-kind histogram,
+measured-time spread) — the pre-flight check before a refit
+(tools/refit_cost_model.py) trusts the corpus.
 """
 
 from __future__ import annotations
@@ -35,6 +41,10 @@ import sys
 from typing import Any, Dict, List, Optional
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+# row schema version: 1 = the original unversioned rows (PR 7 — rows
+# without the field read as 1), 2 adds the explicit "schema_version" field
+SCHEMA_VERSION = 2
 
 
 def collect_rows(path: str) -> List[Dict[str, Any]]:
@@ -78,6 +88,7 @@ def collect_rows(path: str) -> List[Dict[str, Any]]:
         g = groups[key]
         ms = sorted(g["measured"])
         rows.append({
+            "schema_version": SCHEMA_VERSION,
             "key": key,
             "features": g["features"],
             "machine": g["machine"],
@@ -127,6 +138,9 @@ def merge_rows(base: List[Dict[str, Any]], new: List[Dict[str, Any]]
             }
             old["measured_s"] = merged
         old["n"] = n0 + n1
+        # a merged row is as new as its newest contributor (absent = v1)
+        old["schema_version"] = max(int(old.get("schema_version") or 1),
+                                    int(r.get("schema_version") or 1))
         for k in ("predicted_s", "roofline_s", "bound", "attributed_s_mean",
                   "mfu_mean"):
             if r.get(k) is not None:
@@ -180,6 +194,69 @@ def build(path: str, out_path: Optional[str] = None,
     return rows
 
 
+# -------------------------------------------------------------------- stats
+def stats_summary(rows: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Corpus health facts: is this corpus worth refitting a model from?"""
+    kinds: Dict[str, int] = {}
+    machines: Dict[str, int] = {}
+    versions: Dict[int, int] = {}
+    means = []
+    n_meas = 0
+    for r in rows:
+        op = str((r.get("features") or {}).get("op"))
+        kinds[op] = kinds.get(op, 0) + 1
+        mfp = str(r.get("machine") or "")
+        machines[mfp] = machines.get(mfp, 0) + 1
+        v = int(r.get("schema_version") or 1)
+        versions[v] = versions.get(v, 0) + 1
+        n_meas += int(r.get("n") or 0)
+        m = (r.get("measured_s") or {}).get("mean")
+        if m is not None and m > 0:
+            means.append(float(m))
+    means.sort()
+    spread = None
+    if means:
+        spread = {
+            "min_s": means[0],
+            "p50_s": statistics.median(means),
+            "max_s": means[-1],
+            "mean_s": sum(means) / len(means),
+        }
+    return {
+        "rows": len(rows),
+        "measured_rows": len(means),
+        "measurements": n_meas,
+        "machines": sorted(machines),
+        "schema_versions": {str(k): v for k, v in sorted(versions.items())},
+        "op_kinds": dict(sorted(kinds.items(),
+                                key=lambda kv: (-kv[1], kv[0]))),
+        "measured_spread": spread,
+    }
+
+
+def format_stats(s: Dict[str, Any]) -> str:
+    lines = [
+        f"rows: {s['rows']} ({s['measured_rows']} with measurements, "
+        f"{s['measurements']} raw samples)",
+        f"machines: {len(s['machines'])}"
+        + (f" [{', '.join(m[:16] for m in s['machines'])}]"
+           if s["machines"] else ""),
+        "schema versions: " + ", ".join(
+            f"v{k}: {v}" for k, v in s["schema_versions"].items()),
+        "op kinds:",
+    ]
+    for op, n in s["op_kinds"].items():
+        lines.append(f"  {op:<24} {n}")
+    sp = s.get("measured_spread")
+    if sp:
+        lines.append(
+            f"measured mean spread: {sp['min_s'] * 1e6:.2f}us .. "
+            f"p50 {sp['p50_s'] * 1e6:.2f}us .. {sp['max_s'] * 1e6:.2f}us")
+    else:
+        lines.append("measured mean spread: (no measured rows)")
+    return "\n".join(lines)
+
+
 # --------------------------------------------------------------- check mode
 def _check() -> int:
     """CI smoke: profiled tiny fit -> non-empty featurized corpus whose
@@ -224,6 +301,11 @@ def _check() -> int:
                 f"unstable feature key for {r['features'].get('op')}"
             assert r.get("predicted_s") is not None
             assert r.get("roofline_s") is not None
+            assert r.get("schema_version") == SCHEMA_VERSION, r
+        s = stats_summary(back)
+        assert s["rows"] == len(back) and s["measured_rows"] > 0, s
+        assert s["op_kinds"] and s["measured_spread"] is not None, s
+        assert format_stats(s)
         # idempotent-by-key: folding the same telemetry in again must not
         # create new rows (counts grow, keys don't)
         merged = build(tdir, out_path=None, merge=out, quiet=True)
@@ -244,6 +326,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="corpus JSONL path (default <dir>/op_corpus.jsonl)")
     ap.add_argument("--merge", default=None,
                     help="existing corpus to fold the new rows into")
+    ap.add_argument("--stats", action="store_true",
+                    help="print corpus health (rows, machines, op-kind "
+                         "histogram, measured-time spread) and exit")
     ap.add_argument("--check", action="store_true",
                     help="CI smoke: profiled fit -> corpus -> validate")
     args = ap.parse_args(argv)
@@ -251,6 +336,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         return _check()
     if not args.path:
         ap.error("path required (or --check)")
+    if args.stats:
+        rows = (read_jsonl(args.path) if os.path.isfile(args.path)
+                and args.path.endswith(".jsonl") else None)
+        if not rows:
+            rows = collect_rows(args.path)
+        print(format_stats(stats_summary(rows)))
+        return 0
     out = args.out
     if out is None:
         base = args.path if os.path.isdir(args.path) \
