@@ -259,11 +259,32 @@ class FFConfig:
     serve_draft_model: str = ""
     serve_spec_tokens: int = 0
     kv_cache_dtype: str = "auto"
+    # serving observability (ISSUE 15): per-request lifecycle traces +
+    # live latency histograms + SLO error budgets.
+    #   serve_slo        — comma-separated SLO objectives, e.g.
+    #                      "ttft_p99_ms=25,per_token_p99_ms=10,
+    #                       availability=0.999" (health.parse_slo grammar;
+    #                      "" = no objectives, the tracker still counts
+    #                      outcomes). Surfaced via
+    #                      health_report()["serving"]["slo"], the monitor
+    #                      serving panel, and prom burn-rate gauges.
+    #   serve_reqtrace   — per-request stage tracing (serve/req/* spans,
+    #                      streaming histograms, bounded trace ring).
+    #                      Defaults ON and is zero-sync (reuses the
+    #                      scheduler's existing window-boundary
+    #                      timestamps); --no-serve-reqtrace restores the
+    #                      bitwise PR-13 dispatch behavior.
+    serve_slo: str = ""
+    serve_reqtrace: bool = True
 
     REMAT_POLICY_NAMES = ("none", "dots", "full")
 
     def __post_init__(self):
         self._check_remat_knobs()
+        if self.serve_slo:
+            # fail loud at config build, not mid-serve
+            from flexflow_tpu.health import parse_slo
+            parse_slo(self.serve_slo)
 
     def _check_remat_knobs(self):
         """--remat (the deprecated global bool) and the searched-remat
@@ -405,6 +426,11 @@ class FFConfig:
         p.add_argument("--serve-spec-tokens", type=int, default=0)
         p.add_argument("--kv-cache-dtype", type=str, default="auto",
                        choices=("auto", "bf16", "int8"))
+        p.add_argument("--serve-slo", type=str, default="",
+                       help='SLO objectives, e.g. "ttft_p99_ms=25,'
+                            'per_token_p99_ms=10,availability=0.999"')
+        p.add_argument("--serve-reqtrace",
+                       action=argparse.BooleanOptionalAction, default=True)
         return p
 
     @staticmethod
@@ -519,4 +545,6 @@ class FFConfig:
             serve_draft_model=args.serve_draft_model,
             serve_spec_tokens=args.serve_spec_tokens,
             kv_cache_dtype=args.kv_cache_dtype,
+            serve_slo=args.serve_slo,
+            serve_reqtrace=args.serve_reqtrace,
         )

@@ -11,6 +11,8 @@ from flexflow_tpu.serving.kv_cache import (ACTIVE_KEY, KVPoolExhausted,
                                            PAGE_TABLE_KEY, POS_KEY,
                                            PagedKVCache)
 from flexflow_tpu.serving.program import clone_for_serving, serving_optimize
+from flexflow_tpu.serving.reqtrace import (RequestTracer, StreamingHistogram,
+                                           TERMINAL_FIELDS, terminal_record)
 from flexflow_tpu.serving.scheduler import (ContinuousBatchingScheduler,
                                             Request, gpt2_prompt_inputs,
                                             gpt2_step_inputs)
@@ -20,4 +22,6 @@ __all__ = [
     "ContinuousBatchingScheduler", "Request", "clone_for_serving",
     "serving_optimize", "gpt2_prompt_inputs", "gpt2_step_inputs",
     "PAGE_TABLE_KEY", "POS_KEY", "ACTIVE_KEY",
+    "RequestTracer", "StreamingHistogram", "TERMINAL_FIELDS",
+    "terminal_record",
 ]

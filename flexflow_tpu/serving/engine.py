@@ -133,6 +133,12 @@ def compile_serving(model, max_batch_slots: Optional[int] = None,
     clone lowered with the searched decode strategy) batch-verifies the K
     drafted tokens in one pass."""
     cfg = model.config
+    # --telemetry-dir arms the process-global span stream for serving-only
+    # flows too (compile_model does the same; request traces, serve/hist
+    # and serve/slo events all ride this sink)
+    if getattr(cfg, "telemetry_dir", ""):
+        tel.configure(cfg.telemetry_dir,
+                      max_mb=getattr(cfg, "telemetry_max_mb", None))
     slots = int(max_batch_slots or getattr(cfg, "max_batch_slots", 8) or 8)
     max_new = int(max_decode_len or getattr(cfg, "max_decode_len", 0) or 32)
     page = int(kv_page_size or getattr(cfg, "kv_page_size", 16) or 16)
@@ -316,6 +322,13 @@ class ServingCompiled:
                       kv_dtype=str(self.kv_dtype),
                       kv_quantized=self.kv_quantized,
                       spec_tokens=self.spec_tokens)
+
+        # SLO error budgets (ISSUE 15): terminal requests from every
+        # scheduler driving this engine classify into one shared tracker,
+        # so health_report()["serving"]["slo"] is the engine-lifetime view
+        # the fleet router will poll
+        self.slo = health.SLOTracker(
+            health.parse_slo(getattr(self.cfg, "serve_slo", "") or ""))
 
         # hot-swap state (ISSUE 11): watch root + retained version trees
         self.swap_stats = health.SwapStats()
@@ -706,12 +719,15 @@ class ServingCompiled:
     def health_report(self) -> Dict[str, Any]:
         """Predicted-vs-measured HBM watermark for the serving footprint
         (params + KV pools) through the training path's WatermarkTracker,
-        plus the hot-swap ledger: active version, swap/rollback counts,
-        swap latency quantiles."""
+        plus the hot-swap ledger (active version, swap/rollback counts,
+        swap latency quantiles) and the SLO scoreboard (error budget
+        remaining + windowed burn rates per objective, ISSUE 15)."""
+        serving = self.swap_stats.report()
+        serving["slo"] = self.slo.report()
         return {"watermarks":
                 self._watermarks.report(
                     self.memory_stats()["predicted_total_bytes"]),
-                "serving": self.swap_stats.report()}
+                "serving": serving}
 
     def op_attribution(self, kind: str = "both",
                        step_time_s: Optional[float] = None,

@@ -78,6 +78,7 @@ import numpy as np
 from flexflow_tpu import telemetry as tel
 from flexflow_tpu.runtime.resilience import RetryPolicy, run_resilient
 from flexflow_tpu.serving.kv_cache import KVPoolExhausted, POS_KEY
+from flexflow_tpu.serving.reqtrace import RequestTracer, terminal_record
 
 
 @dataclasses.dataclass
@@ -91,6 +92,7 @@ class Request:
     # filled by the scheduler:
     tokens: List[int] = dataclasses.field(default_factory=list)
     ttft_s: Optional[float] = None
+    admit_s: Optional[float] = None  # prefill-dispatch time (queue-wait end)
     finish_s: Optional[float] = None
     slot: Optional[int] = None
     outcome: str = ""             # "done" | "shed" | "failed" | "timeout"
@@ -127,7 +129,8 @@ class ContinuousBatchingScheduler:
                  queue_cap: Optional[int] = None,
                  decode_timeout_ms: Optional[float] = None,
                  prefill_chunk_tokens: int = 0,
-                 retry_policy: Optional[RetryPolicy] = None):
+                 retry_policy: Optional[RetryPolicy] = None,
+                 reqtrace: Optional[bool] = None):
         self.engine = engine
         self.params = params
         self.prompt_inputs_fn = prompt_inputs_fn
@@ -190,6 +193,33 @@ class ContinuousBatchingScheduler:
         self.step_times: List[float] = []
         self.decode_steps = 0
         self.prefills = 0
+        self.materializations = 0  # host syncs that drained a window
+        # request-level tracing (ISSUE 15): zero-sync by construction —
+        # the tracer only ever sees timestamps the loop already took at
+        # its sync points. With reqtrace off there is NO tracer and the
+        # dispatch path is bitwise the PR-13 baseline.
+        rt_on = (reqtrace if reqtrace is not None
+                 else bool(getattr(cfg, "serve_reqtrace", True)))
+        self.tracer: Optional[RequestTracer] = \
+            RequestTracer() if rt_on else None
+        # SLO classification rides the unified terminal records (cheap
+        # host arithmetic, no syncs) so it stays on even without tracing
+        self.slo = getattr(engine, "slo", None)
+        self._t0 = time.perf_counter()  # run() re-anchors
+
+    # ----------------------------------------------------------- terminal
+    def _terminal(self, req: Request, now_s: float, reason: str,
+                  kv_pages: int = 0) -> Dict[str, Any]:
+        """Every outcome funnels through here: build the UNIFIED terminal
+        record (ISSUE 15 satellite — done/shed/failed/timeout all carry
+        the same field schema), classify it against the SLO objectives,
+        and close the request's trace."""
+        rec = terminal_record(req, now_s, kv_pages, reason)
+        if self.slo is not None:
+            self.slo.observe(rec)
+        if self.tracer is not None:
+            self.tracer.on_terminal(req, now_s, rec)
+        return rec
 
     # --------------------------------------------------------- degradation
     def _shed(self, req: Request, reason: str, now_s: float) -> None:
@@ -198,9 +228,9 @@ class ContinuousBatchingScheduler:
         req.finish_s = now_s
         self.shed.append(req)
         self.stats["shed_" + reason] += 1
-        tel.event("serve/request_shed", cat="serve", rid=req.rid,
-                  reason=reason, priority=req.priority,
-                  waited_s=max(0.0, now_s - req.arrival_s))
+        rec = self._terminal(req, now_s, reason)
+        tel.event("serve/request_shed", cat="serve", reason=reason,
+                  waited_s=max(0.0, now_s - req.arrival_s), **rec)
 
     def _fail(self, req: Request, outcome: str, now_s: float,
               err: Optional[BaseException] = None) -> None:
@@ -209,12 +239,17 @@ class ContinuousBatchingScheduler:
         req.slot = None
         self.failed.append(req)
         self.stats["failed"] += 1
-        tel.event("serve/request_failed", cat="serve", rid=req.rid,
-                  outcome=outcome, error=repr(err)[:200] if err else "")
+        rec = self._terminal(
+            req, now_s,
+            "decode_timeout" if outcome == "timeout" else "error")
+        tel.event("serve/request_failed", cat="serve",
+                  error=repr(err)[:200] if err else "", **rec)
 
     def _enqueue(self, req: Request, waiting: List[Request],
                  now_s: float) -> None:
         """The shed-or-queue decision for one arrival."""
+        if self.tracer is not None:
+            self.tracer.on_submit(req, now_s)
         if len(req.prompt) > self.seq:
             # can NEVER be admitted: the prefill program's window is fixed
             # at `seq`; silently truncating the prompt would serve a
@@ -370,28 +405,41 @@ class ContinuousBatchingScheduler:
         serve_ms = 1e3 * (t_first - t_pre)
         self._ema_serve_ms = (serve_ms if not self._ema_serve_ms
                               else 0.5 * self._ema_serve_ms + 0.5 * serve_ms)
+        t_pre_off = t_pre - self._t0
+        t_first_off = t_first - self._t0
         for req in batch:
             first = int(lg[req.slot, lengths[req.slot] - 1].argmax())
             req.tokens.append(first)
-            req.ttft_s = (t_first - self._t0) - req.arrival_s
+            req.ttft_s = t_first_off - req.arrival_s
+            req.admit_s = t_pre_off
             next_host[req.slot, 0] = first
             active[req.slot] = req
+            if self.tracer is not None:
+                # closes the queue stage at prefill dispatch and spans the
+                # prefill wave to the TTFT sync — both timestamps already
+                # taken above, nothing extra is materialized
+                self.tracer.on_admit(req, t_pre_off, t_first_off,
+                                     wave=self.prefills)
             tel.event("serve/request_admitted", cat="serve", rid=req.rid,
                       slot=req.slot, prompt_len=int(lengths[req.slot]),
-                      priority=req.priority, ttft_s=req.ttft_s)
+                      priority=req.priority, ttft_s=req.ttft_s,
+                      queue_wait_s=max(0.0, t_pre_off - req.arrival_s))
         return True
 
     # ------------------------------------------------------------- finish
     def _finish(self, req: Request, now_s: float) -> None:
         req.outcome = "done"
         req.finish_s = now_s
+        kv_pages = len(self.kv._slot_pages.get(req.slot, ()))
         self.kv.evict(req.slot)
         if self._spec:
             self.draft.kv.evict(req.slot)
         self.completed.append(req)
-        tel.event("serve/request_done", cat="serve", rid=req.rid,
-                  tokens=len(req.tokens), ttft_s=req.ttft_s,
-                  total_s=req.finish_s - req.arrival_s)
+        reason = ("eos" if self.eos_id is not None
+                  and self.eos_id in req.tokens else "max_new_tokens")
+        rec = self._terminal(req, now_s, reason, kv_pages=kv_pages)
+        tel.event("serve/request_done", cat="serve",
+                  tokens=len(req.tokens), **rec)
 
     def _truncate(self, req: Request) -> bool:
         """Apply EOS/max-len to a request's token list; True = finished."""
@@ -425,6 +473,7 @@ class ContinuousBatchingScheduler:
         mats = [np.asarray(t) for t in window_toks]
         steps = len(mats)
         t_now = time.perf_counter()
+        self.materializations += 1
         per_step = (t_now - window_t0) / steps
         self.step_times.extend([per_step] * steps)
         adv = np.zeros((self.slots,), np.int32)
@@ -439,6 +488,12 @@ class ContinuousBatchingScheduler:
                 finished.append(slot)
             else:
                 adv[slot] = steps
+        if self.tracer is not None:
+            # attribute the drained window to every slot that decoded in
+            # it, using the t_now this sync already produced
+            self.tracer.on_decode_window(
+                list(active.values()), t_now - self._t0, steps, per_step,
+                {slot: int(adv[slot]) for slot in active})
         self.kv.adopt(state)
         self.kv.sync_after(steps, advances=adv)
         for slot in finished:
@@ -503,6 +558,8 @@ class ContinuousBatchingScheduler:
         t_pred = np.asarray(t_pred_dev)
         drafted = np.asarray(ver_in)[:, 1:]                  # [slots, K]
         wall = time.perf_counter() - t0
+        self.materializations += 1
+        t_end_off = (t0 + wall) - self._t0
         # ---- host commit: nothing below touches the device programs ----
         match = drafted == t_pred[:, :-1]                    # [slots, K]
         adv = np.zeros((self.slots,), np.int32)
@@ -527,6 +584,12 @@ class ContinuousBatchingScheduler:
                 adv[slot] = ncommit
                 out[slot, 0] = committed[-1]
             max_commit = max(max_commit, ncommit)
+            if self.tracer is not None:
+                # drafted-vs-committed-vs-rejected per slot, timed off the
+                # round's already-taken wall timestamp
+                self.tracer.on_spec_round(
+                    req, t_end_off, drafted=K, committed=ncommit,
+                    rejected=K - min(j, K))
         for kv, st in ((self.kv, tstate), (self.draft.kv, dstate)):
             kv.adopt(st)
             kv.sync_after(0, advances=adv)
@@ -549,6 +612,8 @@ class ContinuousBatchingScheduler:
         tel.counter("serve/spec_accept_rate", self._accept_ema, cat="serve")
         per_tok = wall / max_commit
         self.step_times.extend([per_tok] * max_commit)
+        if self.tracer is not None:
+            self.tracer.hists["decode_step"].add(per_tok, n=max_commit)
         self.decode_steps += K + 1
         if self.decode_timeout_ms and active and \
                 1e3 * wall / (K + 1) > self.decode_timeout_ms:
@@ -569,6 +634,8 @@ class ContinuousBatchingScheduler:
         fields filled. Shed and failed requests land in `self.shed` /
         `self.failed` with their outcome + reason stamped."""
         self._t0 = time.perf_counter()
+        if self.tracer is not None:
+            self.tracer.begin(self._t0)
         queue = deque(sorted(requests, key=lambda r: (r.arrival_s, r.rid)))
         waiting: List[Request] = []
         active: Dict[int, Request] = {}
@@ -601,6 +668,12 @@ class ContinuousBatchingScheduler:
                     self.params = self.engine.params
                     self.stats["swaps"] += 1
                     state = self.kv.state
+                    if self.tracer is not None:
+                        # the swap landed between windows: mark it inside
+                        # every in-flight request's timeline
+                        self.tracer.on_swap(
+                            list(active.values()), self._now(),
+                            getattr(self.engine, "active_version", None))
             if waiting:
                 self._shed_stale(waiting, self._now())
             if waiting and self.kv.free_slots():
@@ -655,6 +728,12 @@ class ContinuousBatchingScheduler:
                 logits[:, -1, :], axis=-1).astype(jnp.int32)[:, None]
             window_toks.append(next_dev)
             self.decode_steps += 1
+        if self.tracer is not None:
+            # publish the live histograms + SLO scoreboard into the
+            # telemetry stream (monitor/prom read them from here)
+            self.tracer.emit_hists()
+        if self.slo is not None and tel.enabled():
+            tel.event("serve/slo", cat="serve", report=self.slo.report())
         if getattr(self.engine.cfg, "profile_ops", False) and tel.enabled():
             # --profile-ops (ISSUE 14 satellite): featurize this run's
             # prefill + decode placements into op/attr corpus rows, with

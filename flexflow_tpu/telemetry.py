@@ -189,10 +189,12 @@ def now_us() -> float:
 
 
 def _base(name: str, ph: str, ts: float, cat: Optional[str],
-          args: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+          args: Optional[Dict[str, Any]],
+          tid: Optional[str] = None) -> Dict[str, Any]:
     obj: Dict[str, Any] = {"name": name, "ph": ph, "ts": ts,
                            "pid": os.getpid(),
-                           "tid": threading.current_thread().name}
+                           "tid": tid if tid is not None
+                           else threading.current_thread().name}
     if cat:
         obj["cat"] = cat
     if args:
@@ -201,15 +203,19 @@ def _base(name: str, ph: str, ts: float, cat: Optional[str],
 
 
 def record(name: str, start_us: float, end_us: Optional[float] = None,
-           cat: Optional[str] = None, **args: Any) -> None:
+           cat: Optional[str] = None, tid: Optional[str] = None,
+           **args: Any) -> None:
     """Emit a complete span from explicit timestamps — the hot-loop path:
     callers guard on enabled(), stamp now_us() inline, and pay nothing
-    (not even a context-manager frame) when telemetry is off."""
+    (not even a context-manager frame) when telemetry is off. `tid`
+    overrides the default thread-name track — the serving request tracer
+    uses "slot<k>" so the Chrome export reads as one row per decode slot
+    instead of one row per host thread."""
     s = _SINK
     if s is None:
         return
     end = now_us() if end_us is None else end_us
-    obj = _base(name, "X", start_us, cat, args or None)
+    obj = _base(name, "X", start_us, cat, args or None, tid=tid)
     obj["dur"] = max(0.0, end - start_us)
     s.emit(obj)
 

@@ -271,6 +271,36 @@ def test_health_flags_wired():
     assert "--halt-on-nonfinite" not in vf
 
 
+def test_slo_reqtrace_flags_wired():
+    """The ISSUE-15 observability knobs flow parse_args -> FFConfig via
+    build_parser only: the SLO objective string (validated by parse_slo at
+    construction, so a bad grammar fails loud at startup, not mid-serve)
+    and the request-tracer gate (default ON, BooleanOptionalAction)."""
+    import pytest
+
+    from flexflow_tpu.config import FFConfig as Cfg
+
+    cfg = Cfg.parse_args(["--serve-slo",
+                          "ttft_p99_ms=25,per_token_p99_ms=10,"
+                          "availability=0.999",
+                          "--no-serve-reqtrace"])
+    assert cfg.serve_slo == ("ttft_p99_ms=25,per_token_p99_ms=10,"
+                             "availability=0.999")
+    assert cfg.serve_reqtrace is False
+    d = Cfg()
+    assert d.serve_slo == ""          # no objectives -> tracker idles
+    assert d.serve_reqtrace is True   # tracing is on by default (zero-sync)
+    assert Cfg.parse_args(["--serve-reqtrace"]).serve_reqtrace is True
+    with pytest.raises(ValueError):
+        Cfg(serve_slo="ttft_p99_ms=nope")
+    with pytest.raises(ValueError):
+        Cfg(serve_slo="unknown_metric_p99_ms=5")
+    # --serve-slo consumes a value token; the boolean gate doesn't
+    vf = Cfg.launcher_value_flags()
+    assert "--serve-slo" in vf
+    assert "--serve-reqtrace" not in vf
+
+
 def test_fault_plan_flag_arms_injector(devices):
     """--fault-plan reaches runtime/faults.py at compile time (the same
     hook order as --telemetry-dir): a bad plan fails loud at compile, a

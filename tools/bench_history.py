@@ -123,6 +123,13 @@ FAMILIES: Dict[str, Tuple[str, Callable[[Dict[str, Any]],
                    ("swaps_completed", "swap_p99_s", "dropped_inflight",
                     "overload_shed", "served_ttft_p99_s", "legs_passed")
                    if d.get(k) is not None]),
+    "slo": (
+        r"^BENCH_reqtrace\.json$",
+        lambda d: [(k, float(d[k])) for k in
+                   ("overhead_pct", "accounting_frac_min",
+                    "ttft_budget_remaining", "burn_rate_1m", "shed_rate",
+                    "legs_passed")
+                   if d.get(k) is not None]),
 }
 
 

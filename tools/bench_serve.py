@@ -99,6 +99,18 @@ def _run_leg(eng, gc, n_dev, rate, n_requests, seed):
     done = sched.run(reqs)
     wall = time.perf_counter() - t0
     tokens = sum(len(r.tokens) for r in done)
+
+    # ISSUE 15: quantiles come from the scheduler's live streaming
+    # histograms — the SAME series the monitor panel and prometheus
+    # export read, so bench and dashboard can never disagree. The
+    # timestamp-list recompute survives only as the reqtrace-off
+    # fallback.
+    def hq(metric, q, fallback):
+        h = sched.tracer.hists.get(metric) if sched.tracer else None
+        if h is not None and h.count:
+            return h.quantile(q)
+        return fallback()
+
     ttfts = [r.ttft_s for r in done if r.ttft_s is not None]
     return {
         "arrival_rate_req_s": rate,
@@ -107,10 +119,12 @@ def _run_leg(eng, gc, n_dev, rate, n_requests, seed):
         "wall_s": round(wall, 3),
         "tokens_per_s": round(tokens / wall, 2),
         "tokens_per_s_per_chip": round(tokens / wall / n_dev, 2),
-        "ttft_p50_s": _quantile(ttfts, 0.5),
-        "ttft_p99_s": _quantile(ttfts, 0.99),
-        "per_token_p50_s": _quantile(sched.step_times, 0.5),
-        "per_token_p99_s": _quantile(sched.step_times, 0.99),
+        "ttft_p50_s": hq("ttft", 0.5, lambda: _quantile(ttfts, 0.5)),
+        "ttft_p99_s": hq("ttft", 0.99, lambda: _quantile(ttfts, 0.99)),
+        "per_token_p50_s": hq("decode_step", 0.5,
+                              lambda: _quantile(sched.step_times, 0.5)),
+        "per_token_p99_s": hq("decode_step", 0.99,
+                              lambda: _quantile(sched.step_times, 0.99)),
         "decode_steps": sched.decode_steps,
         "prefill_batches": sched.prefills,
         "spec_accept_rate": (

@@ -67,7 +67,11 @@ def gather(events: List[Dict[str, Any]]) -> Dict[str, Any]:
              "shed": 0, "failed": 0, "evicted": 0, "retries": 0,
              # ISSUE 13: speculative decoding + KV quantization stream
              "spec_drafted": 0, "spec_accepted": 0, "spec_accept_ema": None,
-             "kv_dtype": None, "spec_tokens": 0}
+             "kv_dtype": None, "spec_tokens": 0,
+             # ISSUE 15: streaming latency histograms (serve/hist
+             # snapshots — merged across segments/processes by
+             # _merged_hists) + the last SLO scoreboard
+             "hist_snaps": [], "slo": None}
     for ev in events:
         name = ev.get("name", "")
         args = ev.get("args") or {}
@@ -117,6 +121,10 @@ def gather(events: List[Dict[str, Any]]) -> Dict[str, Any]:
         elif name == "serve/engine":
             serve["kv_dtype"] = args.get("kv_dtype", serve["kv_dtype"])
             serve["spec_tokens"] = int(args.get("spec_tokens") or 0)
+        elif name == "serve/hist":
+            serve["hist_snaps"].append(args)
+        elif name == "serve/slo":
+            serve["slo"] = args.get("report") or serve["slo"]
         elif name == "health/nonfinite":
             sent["nonfinite"] += 1
             last_nonfinite = args
@@ -161,10 +169,37 @@ def _pq(xs: List[float], q: float) -> float:
     return s[min(len(s) - 1, int(q * (len(s) - 1) + 0.5))]
 
 
+def _merged_hists(serve: Dict[str, Any]) -> Dict[str, Any]:
+    """Merge every serve/hist snapshot in the stream into one histogram
+    per metric (fixed shared buckets make the merge exact across
+    segments, processes, and bench legs). Lazy import keeps the pure
+    gather path dependency-free for synthetic-stream tests."""
+    snaps = serve.get("hist_snaps") or []
+    if not snaps:
+        return {}
+    from flexflow_tpu.serving.reqtrace import StreamingHistogram
+
+    out: Dict[str, Any] = {}
+    for s in snaps:
+        metric = s.get("metric")
+        if not metric:
+            continue
+        try:
+            h = StreamingHistogram.from_snapshot(s)
+        except (ValueError, TypeError):
+            continue
+        if metric in out:
+            out[metric].merge(h)
+        else:
+            out[metric] = h
+    return out
+
+
 def _serve_stats(serve: Dict[str, Any]) -> Optional[Dict[str, Any]]:
     """Fold the gathered serve/* stream into the panel's numbers; None
     when the run has no serving activity (panel stays hidden)."""
-    if not (serve["done"] or serve["decode_ms"] or serve["prefills"]):
+    if not (serve["done"] or serve["decode_ms"] or serve["prefills"]
+            or serve.get("hist_snaps")):
         return None
     tokens = sum(int(d.get("tokens", 0)) for d in serve["done"])
     span_s = 0.0
@@ -172,16 +207,30 @@ def _serve_stats(serve: Dict[str, Any]) -> Optional[Dict[str, Any]]:
         span_s = max(0.0, (serve["ts_last"] - serve["ts_first"]) / 1e6)
     ttfts = [float(d["ttft_s"]) for d in serve["done"]
              if d.get("ttft_s") is not None]
+    # ISSUE 15: when the stream carries live histograms they are THE
+    # source of truth for latency quantiles (bench_serve reads the same
+    # histograms, so the two can never disagree); the done-event/span
+    # recompute is only the fallback for pre-15 streams
+    hists = _merged_hists(serve)
+    th, sh = hists.get("ttft"), hists.get("decode_step")
     return {
+        "hists": hists,
+        "slo": serve.get("slo"),
         "requests_done": len(serve["done"]),
         "tokens": tokens,
         "tokens_per_s": tokens / span_s if span_s > 0 else 0.0,
-        "ttft_p50_s": _pq(ttfts, 0.5) if ttfts else None,
-        "ttft_p99_s": _pq(ttfts, 0.99) if ttfts else None,
-        "decode_p50_ms": (_pq(serve["decode_ms"], 0.5)
-                          if serve["decode_ms"] else None),
-        "decode_p99_ms": (_pq(serve["decode_ms"], 0.99)
-                          if serve["decode_ms"] else None),
+        "ttft_p50_s": (th.quantile(0.5) if th is not None and th.count
+                       else (_pq(ttfts, 0.5) if ttfts else None)),
+        "ttft_p99_s": (th.quantile(0.99) if th is not None and th.count
+                       else (_pq(ttfts, 0.99) if ttfts else None)),
+        "decode_p50_ms": (sh.quantile(0.5) * 1e3
+                          if sh is not None and sh.count else
+                          (_pq(serve["decode_ms"], 0.5)
+                           if serve["decode_ms"] else None)),
+        "decode_p99_ms": (sh.quantile(0.99) * 1e3
+                          if sh is not None and sh.count else
+                          (_pq(serve["decode_ms"], 0.99)
+                           if serve["decode_ms"] else None)),
         "active_slots": serve["active_slots"],
         "queue_depth": serve["queue_depth"],
         "shed": serve.get("shed", 0),
@@ -267,6 +316,25 @@ def render(state: Dict[str, Any]) -> List[str]:
                 f"accepted={sv['spec_accepted']} "
                 f"accept_ema={f(rate, '%.2f')}  "
                 f"kv_dtype={sv['kv_dtype'] or '-'}")
+        slo = sv.get("slo")
+        if slo and slo.get("objectives"):
+            # ISSUE 15: error-budget scoreboard — one compact line per
+            # objective (budget left + the fastest-window burn rate)
+            for name, ob in sorted(slo["objectives"].items()):
+                burns = {k: v for k, v in ob.items()
+                         if k.startswith("burn_rate_")}
+                burn_txt = " ".join(
+                    f"{k[len('burn_rate_'):]}={v:.2f}x"
+                    for k, v in sorted(burns.items()))
+                lines.append(
+                    f"slo      {name}: budget "
+                    f"{100.0 * float(ob.get('budget_remaining', 0.0)):.1f}% "
+                    f"left  bad {ob.get('bad', 0)}/{ob.get('total', 0)}  "
+                    f"burn {burn_txt or '-'}")
+            lines.append(
+                f"         requests={slo.get('requests', 0)} "
+                f"shed_rate={100.0 * float(slo.get('shed_rate', 0.0)):.1f}% "
+                f"worst_burn={float(slo.get('worst_burn_rate', 0.0)):.2f}x")
     sent = state["sentinels"]
     bad = sent["nonfinite"] or state["halts"]
     status = "FATAL" if bad else (
@@ -390,6 +458,46 @@ def prom_export(state: Dict[str, Any], path: str) -> None:
             g.append("# TYPE flexflow_serve_kv_cache_dtype_info gauge")
             g.append('flexflow_serve_kv_cache_dtype_info{dtype="%s"} 1'
                      % sv["kv_dtype"])
+        # ISSUE 15: live latency histograms as real Prometheus histogram
+        # series (cumulative le buckets, mergeable across scrapes)
+        _HIST_HELP = {
+            "ttft": "Time to first token of admitted requests",
+            "per_token": "Steady-state inter-token latency of completed "
+                         "requests",
+            "queue_wait": "Queue wait before admission (or until shed)",
+            "prefill": "Chunked-prefill wave latency per admission",
+            "decode_step": "Per-token decode/verify step latency",
+        }
+        for metric, h in sorted((sv.get("hists") or {}).items()):
+            g.extend(h.prom_lines(
+                f"flexflow_serve_{metric}_seconds",
+                _HIST_HELP.get(metric, f"Serving {metric} latency")))
+        slo = sv.get("slo")
+        if slo and slo.get("objectives"):
+            # per-objective error budgets as labeled gauges
+            g.append("# HELP flexflow_serve_slo_budget_remaining "
+                     "Remaining SLO error budget fraction per objective")
+            g.append("# TYPE flexflow_serve_slo_budget_remaining gauge")
+            for name, ob in sorted(slo["objectives"].items()):
+                g.append(
+                    'flexflow_serve_slo_budget_remaining{objective="%s"} %g'
+                    % (name, float(ob.get("budget_remaining", 0.0))))
+            g.append("# HELP flexflow_serve_slo_burn_rate "
+                     "SLO error-budget burn rate per objective and window")
+            g.append("# TYPE flexflow_serve_slo_burn_rate gauge")
+            for name, ob in sorted(slo["objectives"].items()):
+                for k, v in sorted(ob.items()):
+                    if k.startswith("burn_rate_"):
+                        g.append(
+                            'flexflow_serve_slo_burn_rate{objective="%s",'
+                            'window="%s"} %g'
+                            % (name, k[len("burn_rate_"):], float(v)))
+            gauge("flexflow_serve_slo_shed_rate",
+                  float(slo.get("shed_rate", 0.0)),
+                  "Fraction of terminal requests that did not complete")
+            gauge("flexflow_serve_slo_worst_burn_rate",
+                  float(slo.get("worst_burn_rate", 0.0)),
+                  "Max burn rate across objectives and windows")
     tmp = path + ".tmp"
     with open(tmp, "w") as f:
         f.write("\n".join(g) + "\n")

@@ -174,6 +174,79 @@ def error_events(events: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
     return [ev for ev in events if ev.get("cat") == "error"]
 
 
+# ------------------------------------------------- per-request timeline (15)
+_TERMINAL_EVENTS = ("serve/request_done", "serve/request_shed",
+                    "serve/request_failed")
+
+
+def request_timeline(events: List[Dict[str, Any]],
+                     rid: Any) -> Optional[Dict[str, Any]]:
+    """One request's lifecycle from its serve/req/* stage spans: ordered
+    stages (queue -> prefill waves -> decode/spec rounds -> swap) with
+    per-stage duration and share of the request's wall time, plus the
+    unified terminal record. None when the rid never appears."""
+    spans = []
+    for ev in events:
+        if ev.get("ph") != "X":
+            continue
+        if not str(ev.get("name", "")).startswith("serve/req/"):
+            continue
+        args = ev.get("args") or {}
+        if str(args.get("rid")) != str(rid):
+            continue
+        spans.append({
+            "stage": ev["name"][len("serve/req/"):],
+            "start_us": float(ev["ts"]),
+            "dur_us": float(ev.get("dur", 0.0)),
+            "tid": ev.get("tid"),
+            "args": {k: v for k, v in args.items() if k != "rid"},
+        })
+    terminal = None
+    for ev in events:
+        if ev.get("name") in _TERMINAL_EVENTS:
+            args = ev.get("args") or {}
+            if str(args.get("rid")) == str(rid):
+                terminal = dict(args, event=ev["name"])
+    if not spans and terminal is None:
+        return None
+    spans.sort(key=lambda s: (s["start_us"], s["start_us"] + s["dur_us"]))
+    if spans:
+        t0 = min(s["start_us"] for s in spans)
+        t1 = max(s["start_us"] + s["dur_us"] for s in spans)
+        wall_us = max(t1 - t0, 1e-9)
+        accounted = sum(s["dur_us"] for s in spans)
+    else:
+        t0, wall_us, accounted = 0.0, 1e-9, 0.0
+    return {
+        "rid": rid,
+        "t0_us": t0,
+        "wall_ms": wall_us / 1e3,
+        "accounted_frac": accounted / wall_us,
+        "stages": spans,
+        "terminal": terminal,
+    }
+
+
+def print_request_timeline(tl: Dict[str, Any]) -> None:
+    term = tl.get("terminal") or {}
+    print(f"request rid={tl['rid']}  wall={tl['wall_ms']:.2f}ms  "
+          f"accounted={100.0 * tl['accounted_frac']:.1f}%  "
+          f"outcome={term.get('outcome', '?')}"
+          f"({term.get('outcome_reason', '?')})")
+    t0 = tl["t0_us"]
+    for s in tl["stages"]:
+        extra = " ".join(f"{k}={v}" for k, v in sorted(s["args"].items()))
+        pct = 100.0 * s["dur_us"] / max(tl["wall_ms"] * 1e3, 1e-9)
+        print(f"  +{(s['start_us'] - t0) / 1e3:9.2f}ms "
+              f"{s['stage']:12} {s['dur_us'] / 1e3:9.2f}ms {pct:5.1f}%  "
+              f"[{s.get('tid') or '-'}] {extra}")
+    if term:
+        keep = ("priority", "queue_wait_s", "ttft_s", "per_token_s",
+                "tokens_in", "tokens_out", "kv_pages", "total_s")
+        rec = " ".join(f"{k}={term[k]}" for k in keep if k in term)
+        print(f"  terminal {term.get('event', '?')}: {rec}")
+
+
 def render(path: str, out_path: Optional[str] = None, top: int = 0,
            quiet: bool = False) -> Dict[str, Any]:
     """The full report: summary rows + chrome doc + derived sections.
@@ -287,6 +360,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "(default <dir>/trace.json)")
     ap.add_argument("--top", type=int, default=0,
                     help="only the N hottest spans in the summary")
+    ap.add_argument("--rid", default=None,
+                    help="print one serving request's stage timeline "
+                         "(serve/req/* spans) instead of the full report")
     ap.add_argument("--check", action="store_true",
                     help="CI smoke: tiny fit -> render -> validate")
     args = ap.parse_args(argv)
@@ -294,6 +370,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         return _check()
     if not args.path:
         ap.error("path required (or --check)")
+    if args.rid is not None:
+        tl = request_timeline(load_events(args.path), args.rid)
+        if tl is None:
+            print(f"rid {args.rid!r} not found in {args.path}")
+            return 1
+        print_request_timeline(tl)
+        return 0
     out = args.out
     if out is None:
         base = args.path if os.path.isdir(args.path) \
