@@ -276,6 +276,27 @@ class FFConfig:
     #                      bitwise PR-13 dispatch behavior.
     serve_slo: str = ""
     serve_reqtrace: bool = True
+    # long-context serving (ISSUE 16): tiered KV cache + prefetch-ahead.
+    #   kv_host_pages     — host-memory cold-tier pages per KV pool. > 0
+    #                       shrinks the HBM pool by the same amount
+    #                       (floored at one slot's worth) and lets the
+    #                       scheduler park idle-enough slots on the host,
+    #                       so total servable context at a fixed HBM-page
+    #                       budget grows by rotation. 0 = untiered, the
+    #                       exact pre-tier geometry.
+    #   kv_prefetch_ahead — decode steps before a parked slot's rejoin
+    #                       that its host→HBM refill is issued; a rejoin
+    #                       with less lead counts a prefetch stall. Also
+    #                       the denominator the decode roofline amortizes
+    #                       unhidden prefetch traffic over.
+    #   serve_max_context — operator context ceiling (prompt + decode
+    #                       budget, tokens): arrivals over it shed
+    #                       permanently as over_max_context, distinct from
+    #                       a transiently full pool (which queues).
+    #                       0 = no ceiling.
+    kv_host_pages: int = 0
+    kv_prefetch_ahead: int = 2
+    serve_max_context: int = 0
 
     REMAT_POLICY_NAMES = ("none", "dots", "full")
 
@@ -431,6 +452,9 @@ class FFConfig:
                             'per_token_p99_ms=10,availability=0.999"')
         p.add_argument("--serve-reqtrace",
                        action=argparse.BooleanOptionalAction, default=True)
+        p.add_argument("--kv-host-pages", type=int, default=0)
+        p.add_argument("--kv-prefetch-ahead", type=int, default=2)
+        p.add_argument("--serve-max-context", type=int, default=0)
         return p
 
     @staticmethod
@@ -547,4 +571,7 @@ class FFConfig:
             kv_cache_dtype=args.kv_cache_dtype,
             serve_slo=args.serve_slo,
             serve_reqtrace=args.serve_reqtrace,
+            kv_host_pages=args.kv_host_pages,
+            kv_prefetch_ahead=args.kv_prefetch_ahead,
+            serve_max_context=args.serve_max_context,
         )

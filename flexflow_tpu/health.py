@@ -674,6 +674,29 @@ class SLOTracker:
         }
 
 
+def format_kv_tier(tier_stats: Dict[str, Any]) -> Dict[str, Any]:
+    """Normalize a PagedKVCache.tier_stats() snapshot into the health
+    report's serving section: occupancy, transfer totals, and the derived
+    prefetch hit rate (hits / (hits + stalls); 1.0 with no rejoins — an
+    idle tier has missed nothing)."""
+    hits = int(tier_stats.get("kv_prefetch_hits", 0))
+    stalls = int(tier_stats.get("kv_prefetch_stalls", 0))
+    joins = hits + stalls
+    return {
+        "hot_pages": int(tier_stats.get("kv_hot_pages", 0)),
+        "cold_pages": int(tier_stats.get("kv_cold_pages", 0)),
+        "host_pages_total": int(tier_stats.get("kv_host_pages_total", 0)),
+        "parked_slots": int(tier_stats.get("kv_parked_slots", 0)),
+        "spills": int(tier_stats.get("kv_spills", 0)),
+        "refills": int(tier_stats.get("kv_refills", 0)),
+        "spilled_bytes": int(tier_stats.get("kv_spilled_bytes", 0)),
+        "refilled_bytes": int(tier_stats.get("kv_refilled_bytes", 0)),
+        "prefetch_hits": hits,
+        "prefetch_stalls": stalls,
+        "prefetch_hit_rate": (hits / joins) if joins else 1.0,
+    }
+
+
 def format_health(sentinels: Optional[Dict[str, Any]],
                   watermarks: Optional[Dict[str, Any]]) -> List[str]:
     """The `[health]` report lines (profile_report; bench reuses)."""

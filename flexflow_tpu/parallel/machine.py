@@ -67,6 +67,12 @@ class MachineSpec:
     # this factor entirely with event-driven replay (simulator_mode=
     # "taskgraph").
     overlap_frac: float = 0.7
+    # host link bandwidth (bytes/s per chip): the PCIe/DCN-tier path the
+    # tiered KV cache's spill/prefetch traffic rides (jax.device_put /
+    # device_get to pinned host buffers). Far below hbm_bw by construction —
+    # this gap is what the decode roofline charges for unhidden prefetch
+    # traffic when a host tier is on.
+    host_bw: float = 0.0
 
     def __post_init__(self):
         preset = CHIP_PRESETS.get(self.chip, CHIP_PRESETS["v5e"])
@@ -76,6 +82,8 @@ class MachineSpec:
             self.hbm_bw = preset[1]
         if not self.hbm_bytes:
             self.hbm_bytes = preset[2]
+        if not self.host_bw:
+            self.host_bw = 16e9  # PCIe-class default
         for ax in self.mesh_axes:
             if ax not in self.ici_bw:
                 self.ici_bw[ax] = self.dcn_bw if ax in self.dcn_axes else preset[3]
@@ -114,6 +122,7 @@ class MachineSpec:
             "mxu_min_dim": self.mxu_min_dim,
             "axis_type": self.axis_type,
             "overlap_frac": self.overlap_frac,
+            "host_bw": self.host_bw,
         }
 
     @staticmethod
@@ -131,6 +140,7 @@ class MachineSpec:
             mxu_min_dim=d.get("mxu_min_dim", 128),
             axis_type=dict(d.get("axis_type", {})),
             overlap_frac=d.get("overlap_frac", 0.7),
+            host_bw=d.get("host_bw", 0.0),
         )
 
     @staticmethod

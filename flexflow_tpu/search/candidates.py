@@ -57,6 +57,11 @@ class Candidate:
     # marker nodes so they never force a gather of the batch sharding.
     passthrough: bool = False
     drop_axis: Optional[str] = None
+    # forward-only share of extra_comm (s): extra_comm prices the training
+    # step (fwd+bwd); serving cost fns run forward-only programs, so ring
+    # rotation and flash-infeasibility penalties must not charge the bwd
+    # passes there. None = no fwd/bwd split known; use extra_comm.
+    extra_comm_fwd: Optional[float] = None
 
     def memo_key(self) -> tuple:
         """Hashable identity of this placement (tier-2 interning)."""
@@ -66,7 +71,8 @@ class Candidate:
                 tuple(sorted((w, memo.freeze_dims(d))
                              for w, d in self.weight_dims.items())),
                 self.compute_degree, self.extra_comm, self.eff,
-                self.weight_stream_frac, self.passthrough, self.drop_axis)
+                self.weight_stream_frac, self.passthrough, self.drop_axis,
+                self.extra_comm_fwd)
 
     def op_time(self, layer: "Layer", machine: MachineSpec) -> float:
         # interned by (op params key, placement, machine): structural twins
@@ -428,12 +434,13 @@ def _layer_candidates(layer: "Layer", machine: MachineSpec, batch_sizes,
                 kv_chunk = cm.shard_bytes(kspec, sdims[1], machine)
                 # fwd: k+v rotate (dm-1) times; bwd (custom VJP second ring
                 # pass): k, v, dk, dv rotate dm times each
-                ring_comm = ((2.0 * (dm - 1) + 4.0 * dm) * kv_chunk
-                             / machine.axis_bw(m))
+                ring_fwd = 2.0 * (dm - 1) * kv_chunk / machine.axis_bw(m)
+                ring_comm = (ring_fwd
+                             + 4.0 * dm * kv_chunk / machine.axis_bw(m))
                 cands.append(Candidate(
                     f"sp_ring:{m}", sdims, sout, dict(repl_w),
                     compute_degree=max(1, dp.compute_degree) * dm,
-                    extra_comm=ring_comm))
+                    extra_comm=ring_comm, extra_comm_fwd=ring_fwd))
         # where the flash kernel can't cover the shape (q OR k/v past the
         # VMEM budget, or causal cross-shapes), non-ring candidates pay the
         # full (sq, sk) logits materialization through HBM (3x for fwd+bwd)
@@ -447,9 +454,11 @@ def _layer_candidates(layer: "Layer", machine: MachineSpec, batch_sizes,
             logits_bytes = q.shape[0] * heads * seq * seq_k * max(4, isz)
             for c in cands:
                 if not c.name.startswith("sp_ring:"):
-                    c.extra_comm += (3.0 * 2.0 * logits_bytes
-                                     / max(1, c.compute_degree)
-                                     / machine.hbm_bw)
+                    pen_fwd = (1.0 * 2.0 * logits_bytes
+                               / max(1, c.compute_degree) / machine.hbm_bw)
+                    c.extra_comm_fwd = (c.extra_comm if c.extra_comm_fwd
+                                        is None else c.extra_comm_fwd) + pen_fwd
+                    c.extra_comm += 3.0 * pen_fwd
 
     elif t is OperatorType.EMBEDDING:
         tbl = layer.weight_specs["kernel"]

@@ -89,6 +89,15 @@ class KVCacheSpec:
     # per-head scale factor stored NEXT TO each (page_size, heads) row of
     # int8 values — 0 for unquantized caches, 4 (one f32) for int8
     scale_itemsize: int = 0
+    # tiered cache (ISSUE 16): a host-memory cold tier of `host_pages`
+    # pages per pool next to a device pool of `device_pages` data pages
+    # (0 = the untiered default slots * pages_per_slot — bitwise the old
+    # geometry). With a host tier the device pool may be SMALLER than
+    # slots * pages_per_slot: parked slots' pages live on host and the
+    # scheduler rotates a hot subset through HBM, which is how servable
+    # context grows at fixed HBM-page budget.
+    host_pages: int = 0
+    device_pages: int = 0
 
     @property
     def padded_len(self) -> int:
@@ -97,14 +106,20 @@ class KVCacheSpec:
 
     @property
     def pool_pages(self) -> int:
-        """Pages in one pool: every slot's worth plus the scratch page."""
-        return self.slots * self.pages_per_slot + 1
+        """Pages in one DEVICE pool: the data pages (device_pages when a
+        host tier shrinks HBM, else every slot's worth) plus scratch."""
+        return (self.device_pages or self.slots * self.pages_per_slot) + 1
+
+    def page_bytes(self) -> int:
+        """K + V bytes of ONE page of ONE layer (the unit the tier moves:
+        spill/prefetch copy whole pages, values plus quantized scales)."""
+        return (2 * self.page_size * self.heads
+                * (self.head_dim * self.itemsize + self.scale_itemsize))
 
     def layer_bytes(self) -> int:
         """K + V pool bytes for ONE attention layer (unsharded), including
         the per-(page entry, head) scale arrays of a quantized pool."""
-        return (2 * self.pool_pages * self.page_size * self.heads
-                * (self.head_dim * self.itemsize + self.scale_itemsize))
+        return self.pool_pages * self.page_bytes()
 
     def total_bytes(self) -> int:
         return self.layers * self.layer_bytes()
@@ -120,10 +135,20 @@ class KVCacheSpec:
         decode cost_fn charges on top of the weight streaming."""
         return self.total_bytes() // max(1, model_degree)
 
+    def slot_bytes(self) -> int:
+        """Worst-case K/V bytes of ONE slot across all layers — the
+        payload a full spill or refill of a parked slot moves over the
+        host link."""
+        return self.layers * self.pages_per_slot * self.page_bytes()
+
+    def host_bytes(self) -> int:
+        """Cold-tier capacity bytes (all layers; 0 without a host tier)."""
+        return self.layers * self.host_pages * self.page_bytes()
+
     def fingerprint(self) -> tuple:
         return (self.layers, self.heads, self.head_dim, self.slots,
                 self.pages_per_slot, self.page_size, self.itemsize,
-                self.scale_itemsize)
+                self.scale_itemsize, self.host_pages, self.device_pages)
 
 
 def zero_divisor(spec: TensorSpec, dims: Sequence[DimSharding],

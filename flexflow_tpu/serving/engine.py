@@ -165,18 +165,32 @@ def compile_serving(model, max_batch_slots: Optional[int] = None,
         mesh = build_mesh(machine)
         pre_model, attn = clone_for_serving(model, "prefill", slots)
         dec_model, _ = clone_for_serving(model, "decode", slots)
+        # tiered KV (--kv-host-pages H > 0): host pages SUBSTITUTE device
+        # pages — the HBM pool shrinks to slots*pages_per_slot - H (floored
+        # at one slot's worth, the minimum a decoding slot must keep hot),
+        # so total two-tier capacity stays slots*pages_per_slot while the
+        # HBM-page budget drops. H = 0 keeps the exact untiered geometry.
+        pages_per_slot = -(-(seq + max_new) // page)
+        host_pages = max(0, int(getattr(cfg, "kv_host_pages", 0) or 0))
+        device_pages = 0
+        if host_pages:
+            device_pages = max(pages_per_slot,
+                               slots * pages_per_slot - host_pages)
+        prefetch_ahead = max(1, int(getattr(cfg, "kv_prefetch_ahead", 2)
+                                    or 2))
         kv_spec = cm.KVCacheSpec(
             layers=len(attn), heads=heads, head_dim=embed // heads,
-            slots=slots, pages_per_slot=-(-(seq + max_new) // page),
+            slots=slots, pages_per_slot=pages_per_slot,
             page_size=page, itemsize=kv_itemsize,
-            scale_itemsize=kv_scale_itemsize)
+            scale_itemsize=kv_scale_itemsize,
+            host_pages=host_pages, device_pages=device_pages)
         searched = (getattr(cfg, "search_budget", 0) > 0
                     and not cfg.only_data_parallel
                     and machine.num_devices > 1)
         if searched:
             pre_st = serving_optimize(pre_model, machine, "prefill", attn)
             dec_st = serving_optimize(dec_model, machine, "decode", attn,
-                                      kv_spec)
+                                      kv_spec, prefetch_ahead=prefetch_ahead)
         else:
             pre_st = data_parallel_strategy(pre_model, machine)
             dec_st = data_parallel_strategy(dec_model, machine)
@@ -250,7 +264,7 @@ class ServingCompiled:
         heads_axis = _wq_heads_axis(decode_strategy, self.attn_layers)
         self.kv = PagedKVCache(kv_spec, self.attn_layers, mesh,
                                heads_axis=heads_axis, dtype=self.kv_dtype,
-                               quantized=self.kv_quantized)
+                               quantized=self.kv_quantized, machine=machine)
         deg = 1
         if self.kv.heads_axis is not None:
             axes = (self.kv.heads_axis,) if isinstance(self.kv.heads_axis, str) \
@@ -714,6 +728,11 @@ class ServingCompiled:
             "predicted_total_bytes": int(pred_kv + pred_params),
             "actual_param_bytes_per_device": per_device_bytes(self.params),
             "actual_kv_cache_bytes_per_device": self.kv.device_bytes(),
+            # host cold tier: accounted SEPARATELY from the HBM figures
+            # above (predicted==actual pins on the device numbers stay
+            # exact; host bytes never compete for the HBM budget)
+            "predicted_kv_host_bytes": int(self.kv_spec.host_bytes()),
+            "actual_kv_host_bytes": int(self.kv.host_bytes()),
         }
 
     def health_report(self) -> Dict[str, Any]:
@@ -724,6 +743,8 @@ class ServingCompiled:
         remaining + windowed burn rates per objective, ISSUE 15)."""
         serving = self.swap_stats.report()
         serving["slo"] = self.slo.report()
+        if self.kv.host_pages:
+            serving["kv_tier"] = health.format_kv_tier(self.kv.tier_stats())
         return {"watermarks":
                 self._watermarks.report(
                     self.memory_stats()["predicted_total_bytes"]),

@@ -24,6 +24,19 @@ The pools + table + per-slot position/active vectors travel through the
 decode program as lowering state (`compile.build_forward`'s state →
 new_state channel): `state[layer_name] = {"k", "v"}`,
 `state["serve/page_table"]`, `state["serve/pos"]`, `state["serve/active"]`.
+
+Host cold tier (--kv-host-pages > 0): causal decode streams a slot's whole
+committed working set every step, so pages cannot go cold while their slot
+decodes — the tier works at SLOT granularity. `spill` parks an active slot:
+its pages' K/V move to pinned host buffers (`jax.device_get`), the device
+pages return to the free list, and the slot deactivates with its position
+preserved. `prefetch` issues the host→HBM copy for a parked slot (async
+`jax.device_put` + pool scatter — dispatch returns immediately, the copy
+rides the dataflow edge into the next decode step, never a silent block);
+`join` reactivates the slot and classifies the rejoin as a prefetch hit
+(issued ≥ prefetch-ahead steps early) or a counted stall. Host pages come
+from their own free list, so `admit`/`evict` capacity accounting spans
+both tiers.
 """
 
 from __future__ import annotations
@@ -124,8 +137,9 @@ class PagedKVCache:
 
     def __init__(self, spec: KVCacheSpec, attn_layers: List[str],
                  mesh: Optional[Mesh] = None, heads_axis=None,
-                 dtype=jnp.float32, quantized: bool = False):
+                 dtype=jnp.float32, quantized: bool = False, machine=None):
         self.spec = spec
+        self.machine = machine  # host_bw source for transfer pricing rows
         self.attn_layers = list(attn_layers)
         self.mesh = mesh
         self.heads_axis = None
@@ -176,6 +190,24 @@ class PagedKVCache:
         self._active = np.zeros((spec.slots,), np.int32)
         self.free_pages: List[int] = list(range(1, spec.pool_pages))
         self._slot_pages: Dict[int, List[int]] = {}
+        # host cold tier: per-layer pinned buffers shaped like the pools
+        # minus the page dim ([host_pages, page_size, heads, head_dim] for
+        # values, [host_pages, page_size, heads] for quantized scales)
+        self.host_pages = int(spec.host_pages)
+        self._host: Dict[str, Dict[str, np.ndarray]] = {}
+        if self.host_pages:
+            for n in self.attn_layers:
+                self._host[n] = {
+                    key: np.zeros((self.host_pages,) + tuple(leaf.shape[1:]),
+                                  leaf.dtype)
+                    for key, leaf in self.state[n].items()}
+        self.free_host_pages: List[int] = list(range(self.host_pages))
+        self._cold: Dict[int, List[int]] = {}   # parked slot -> host page ids
+        self._inflight: Dict[int, int] = {}     # slot -> prefetch issue step
+        self.tier_counters: Dict[str, int] = {
+            "kv_spills": 0, "kv_refills": 0, "kv_prefetch_hits": 0,
+            "kv_prefetch_stalls": 0, "kv_spilled_bytes": 0,
+            "kv_refilled_bytes": 0}
         self._push_tables()
 
     # ------------------------------------------------------------ host ops
@@ -189,7 +221,10 @@ class PagedKVCache:
         self.state[ACTIVE_KEY] = self._put_repl(self._active)
 
     def free_slots(self) -> List[int]:
-        return [i for i in range(self.spec.slots) if not self._active[i]]
+        # parked (cold/inflight) slots are inactive on device but occupied:
+        # their KV lives in the host tier under the same slot id
+        return [i for i in range(self.spec.slots)
+                if not self._active[i] and i not in self._cold]
 
     def pages_needed(self, total_tokens: int) -> int:
         cap = min(int(total_tokens), self.spec.padded_len)
@@ -197,6 +232,15 @@ class PagedKVCache:
 
     def can_admit(self, total_tokens: int) -> bool:
         return len(self.free_pages) >= self.pages_needed(total_tokens)
+
+    def capacity_pages(self) -> int:
+        """Total data pages across BOTH tiers — the figure `prompt_too_long`
+        and admission shedding must compare against (ISSUE 16: capacity
+        spans HBM + host, not HBM-only)."""
+        return (self.spec.pool_pages - 1) + self.host_pages
+
+    def total_free_pages(self) -> int:
+        return len(self.free_pages) + len(self.free_host_pages)
 
     def admit(self, slot: int, prompt_len: int, total_tokens: int) -> bool:
         """Assign pages for a sequence that will hold up to `total_tokens`
@@ -206,7 +250,7 @@ class PagedKVCache:
         — the scheduler's shed-or-queue path decides whether the request
         waits (backpressure) or is shed, instead of a bare free-list
         IndexError mid-drain."""
-        if self._active[slot]:
+        if self._active[slot] or slot in self._cold:
             raise ValueError(f"slot {slot} is occupied")
         need = self.pages_needed(total_tokens)
         if len(self.free_pages) < need:
@@ -221,9 +265,13 @@ class PagedKVCache:
         return True
 
     def evict(self, slot: int) -> None:
-        """Return the slot's pages to the free list; stale pool contents
-        are never attended (position mask) and get overwritten on reuse."""
+        """Return the slot's pages to the free list(s); stale pool contents
+        are never attended (position mask) and get overwritten on reuse.
+        A parked slot's pages live in the host tier — those return to the
+        host free list instead."""
         self.free_pages.extend(self._slot_pages.pop(slot, []))
+        self.free_host_pages.extend(self._cold.pop(slot, []))
+        self._inflight.pop(slot, None)
         self._table[slot] = 0
         self._pos[slot] = 0
         self._active[slot] = 0
@@ -246,6 +294,153 @@ class PagedKVCache:
         """Publish the host mirrors to the device state (after a batch of
         admissions/evictions)."""
         self._push_tables()
+
+    # ------------------------------------------------------- host tier ops
+    def parked_slots(self) -> List[int]:
+        """Slots whose KV sits in the host tier with no prefetch in flight
+        — the scheduler's rotation candidates."""
+        return [s for s in self._cold if s not in self._inflight]
+
+    def can_spill(self, slot: int) -> bool:
+        return bool(self.host_pages) and bool(self._active[slot]) and \
+            len(self.free_host_pages) >= len(self._slot_pages.get(slot, []))
+
+    def _transfer_row(self, direction: str, pages: int, measured_s: float) -> None:
+        """Emit one `op/attr` telemetry row for a tier transfer, shaped like
+        the per-op attribution rows: the learned cost model refits a
+        `kv_transfer` coefficient from these exactly as it refits any op
+        kind (features carry the shapes + machine fingerprint; predicted_s
+        is the host-link roofline the refit corrects)."""
+        from flexflow_tpu import telemetry as tel
+        from flexflow_tpu.attribution import OP_EVENT, feature_key
+        from flexflow_tpu.search import memo
+        moved = self.spec.layers * pages * self.spec.page_bytes()
+        host_bw = getattr(self.machine, "host_bw", 0.0) or 16e9
+        predicted = moved / host_bw
+        features = {
+            "op": "kv_transfer",
+            "in_shapes": [[pages, self.spec.page_size, self.spec.heads,
+                           self.spec.head_dim]],
+            "out_shapes": [[pages, self.spec.page_size, self.spec.heads,
+                            self.spec.head_dim]],
+            "weight_shapes": [],
+            "dtype": "int8" if self.quantized else "float32",
+            "params": 0,
+            "layout": direction,
+            "sharding": {"out": [], "weights": []},
+            "machine": (memo.machine_fingerprint(self.machine)
+                        if self.machine is not None else ()),
+        }
+        tel.event(OP_EVENT, cat="op", layer=f"kv_cache/{direction}",
+                  op="kv_transfer", candidate=direction,
+                  predicted_s=predicted, measured_s=measured_s,
+                  attributed_s=measured_s, roofline_s=predicted,
+                  bound="host_bw", mfu=0.0, mfu_ceiling=0.0,
+                  key=feature_key(features), features=features,
+                  source="serve", bytes=moved)
+
+    def spill(self, slot: int, decode_step: int) -> None:
+        """Park an active slot: gather its pages from every layer's pools
+        to the host buffers (one `jax.device_get` per leaf), return the
+        device pages, and deactivate the slot keeping its position. The
+        caller (scheduler) batches `push()` after a rotation round."""
+        import time as _time
+        from flexflow_tpu import telemetry as tel
+        if not self.can_spill(slot):
+            raise ValueError(f"cannot spill slot {slot}")
+        pages = self._slot_pages.pop(slot)
+        host_ids = [self.free_host_pages.pop() for _ in pages]
+        idx = jnp.asarray(np.asarray(pages, np.int32))
+        t0 = _time.perf_counter()
+        with tel.span("serve/kv_spill", cat="serve", slot=int(slot),
+                      pages=len(pages)):
+            for n in self.attn_layers:
+                for key, leaf in self.state[n].items():
+                    rows = jax.device_get(leaf[idx])
+                    self._host[n][key][host_ids] = rows
+        self.free_pages.extend(pages)
+        self._cold[slot] = host_ids
+        self._table[slot] = 0
+        self._active[slot] = 0
+        moved = self.spec.layers * len(pages) * self.spec.page_bytes()
+        self.tier_counters["kv_spills"] += 1
+        self.tier_counters["kv_spilled_bytes"] += moved
+        self._transfer_row("spill", len(pages), _time.perf_counter() - t0)
+
+    def prefetch(self, slot: int, decode_step: int) -> bool:
+        """Issue the host→HBM refill for a parked slot: allocate device
+        pages, dispatch the async copy + pool scatter (jax returns before
+        the transfer lands — the decode step that first reads these pages
+        waits on the dataflow edge, never on a host sync), and restore the
+        slot's table row. The slot stays INACTIVE until `join` so the hit/
+        stall ledger reflects when the scheduler actually needed it.
+        Returns False (no-op) when the device free list can't cover it."""
+        import time as _time
+        from flexflow_tpu import telemetry as tel
+        host_ids = self._cold.get(slot)
+        if host_ids is None or slot in self._inflight:
+            raise ValueError(f"slot {slot} is not parked")
+        need = len(host_ids)
+        if len(self.free_pages) < need:
+            return False
+        pages = [self.free_pages.pop() for _ in range(need)]
+        idx = jnp.asarray(np.asarray(pages, np.int32))
+        t0 = _time.perf_counter()
+        with tel.span("serve/kv_prefetch", cat="serve", slot=int(slot),
+                      pages=need, step=int(decode_step)):
+            for n in self.attn_layers:
+                st = dict(self.state[n])
+                for key, leaf in st.items():
+                    rows = jnp.asarray(self._host[n][key][host_ids])
+                    sh = (self._pool_sharding if leaf.ndim == 4
+                          else self._scale_sharding)
+                    if sh is not None:
+                        rows = jax.device_put(rows, sh)
+                    st[key] = leaf.at[idx].set(rows.astype(leaf.dtype))
+                self.state[n] = st
+        row = np.zeros(self.spec.pages_per_slot, np.int32)
+        row[:need] = pages
+        self._table[slot] = row
+        self._slot_pages[slot] = pages
+        self._inflight[slot] = int(decode_step)
+        moved = self.spec.layers * need * self.spec.page_bytes()
+        self.tier_counters["kv_refills"] += 1
+        self.tier_counters["kv_refilled_bytes"] += moved
+        self._transfer_row("prefetch", need, _time.perf_counter() - t0)
+        return True
+
+    def join(self, slot: int, decode_step: int, prefetch_ahead: int) -> bool:
+        """Reactivate a slot whose refill was issued by `prefetch`. Returns
+        True when the rejoin STALLED: the copy was issued fewer than
+        `prefetch_ahead` decode steps ago, so by the tier's own pricing
+        model the transfer had not had time to hide behind decode compute.
+        Stalls are counted, never silent (ISSUE 16)."""
+        issued = self._inflight.pop(slot, None)
+        if issued is None:
+            raise ValueError(f"slot {slot} has no prefetch in flight")
+        self.free_host_pages.extend(self._cold.pop(slot))
+        self._active[slot] = 1
+        stalled = (int(decode_step) - issued) < max(1, int(prefetch_ahead))
+        if stalled:
+            self.tier_counters["kv_prefetch_stalls"] += 1
+        else:
+            self.tier_counters["kv_prefetch_hits"] += 1
+        return stalled
+
+    def tier_stats(self) -> Dict[str, int]:
+        """Counters + occupancy snapshot for telemetry/monitoring."""
+        hot = (self.spec.pool_pages - 1) - len(self.free_pages)
+        cold = self.host_pages - len(self.free_host_pages)
+        out = dict(self.tier_counters)
+        out.update(kv_hot_pages=hot, kv_cold_pages=cold,
+                   kv_parked_slots=len(self._cold),
+                   kv_host_pages_total=self.host_pages)
+        return out
+
+    def host_bytes(self) -> int:
+        """Cold-tier buffer bytes actually allocated on the host."""
+        return sum(int(buf.nbytes) for layer in self._host.values()
+                   for buf in layer.values())
 
     # ---------------------------------------------------------- device ops
     def commit_prefill(self, kv_state, slot_ids, lengths) -> None:

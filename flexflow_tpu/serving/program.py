@@ -142,26 +142,49 @@ def attn_head_degree(strategy_or_result, attn_layers, machine: MachineSpec) -> i
     return deg
 
 
+def _fwd_comm(cand) -> float:
+    """Forward-only collectives of a candidate: serving programs never run
+    the backward pass, so prefer extra_comm_fwd (set by sp_ring and the
+    flash-infeasibility penalty) over the fwd+bwd extra_comm. Without the
+    split, sp_ring's bwd double-ring would be charged against forward-only
+    prefill and the DP could never find the honest ring-vs-flash crossover."""
+    fwd = getattr(cand, "extra_comm_fwd", None)
+    return cand.extra_comm if fwd is None else fwd
+
+
 def _prefill_cost_fn(machine: MachineSpec):
     """Forward-only roofline: compute leg vs memory leg (op_roofline's legs
     are fwd+bwd — 3x flops, 2x bytes — so divide back to the forward pass)
-    plus the candidate's inherent collectives. Prefill over a full prompt
-    is compute-bound, so t_flop dominates and the search ranks layouts by
-    how well they split the matmuls without adding output all-reduces."""
+    plus the candidate's inherent forward collectives. Prefill over a full
+    prompt is compute-bound, so t_flop dominates and the search ranks
+    layouts by how well they split the matmuls without adding output
+    all-reduces — until the prompt outgrows the flash kernel's VMEM budget,
+    where the logits-materialization penalty makes sp_ring's ring hops the
+    cheaper forward path (the searched ring-vs-flash crossover)."""
 
     def cost(layer, cand):
         rf = cm.op_roofline(layer, cand, machine)
-        return max(rf["t_flop_s"] / 3.0, rf["t_mem_s"] / 2.0) + cand.extra_comm
+        return max(rf["t_flop_s"] / 3.0, rf["t_mem_s"] / 2.0) + _fwd_comm(cand)
 
     return cost
 
 
-def _decode_cost_fn(machine: MachineSpec, kv_layer_bytes: int):
+def _decode_cost_fn(machine: MachineSpec, kv_layer_bytes: int,
+                    kv_spec: Optional["cm.KVCacheSpec"] = None,
+                    prefetch_ahead: int = 1):
     """Bandwidth-bound pricing for the single-token step: the forward
     memory leg (dominated by streaming the layer's weight shard — seq=1
     makes every matmul a matvec) plus this layer's share of the live KV
     working set, divided by the candidate's head-shard degree (the pools
-    are sharded over heads along the same axis as wq/wk/wv)."""
+    are sharded over heads along the same axis as wq/wk/wv).
+
+    With a host tier (kv_spec.host_pages > 0) each step also carries the
+    tier's refill traffic: rotating a parked slot back moves one slot-layer
+    over the host link, amortized over the `prefetch_ahead` steps the
+    scheduler issues it early — traffic hidden behind more decode steps
+    costs less per step, which is exactly the knob --kv-prefetch-ahead
+    turns. The learned cost model refits this term from the kv_transfer
+    telemetry rows like any other op."""
 
     def cost(layer, cand):
         rf = cm.op_roofline(layer, cand, machine)
@@ -170,14 +193,19 @@ def _decode_cost_fn(machine: MachineSpec, kv_layer_bytes: int):
             wq = cand.weight_dims.get("wq")
             deg = cm.dims_degree([wq[1]], machine) if wq and len(wq) > 1 else 1
             t += kv_layer_bytes / max(1, deg) / machine.hbm_bw
-        return t + cand.extra_comm
+            if kv_spec is not None and kv_spec.host_pages > 0:
+                t += (kv_spec.pages_per_slot * kv_spec.page_bytes()
+                      / max(1, deg) / machine.host_bw
+                      / max(1, prefetch_ahead))
+        return t + _fwd_comm(cand)
 
     return cost
 
 
 def serving_optimize(smodel: FFModel, machine: MachineSpec, kind: str,
                      attn_layers: List[str],
-                     kv_spec: Optional["cm.KVCacheSpec"] = None):
+                     kv_spec: Optional["cm.KVCacheSpec"] = None,
+                     prefetch_ahead: int = 0):
     """Run the frontier DP on one serving program and return its Strategy.
 
     Warm path: the strategy cache keys on the serving graph's fingerprint
@@ -198,6 +226,10 @@ def serving_optimize(smodel: FFModel, machine: MachineSpec, kind: str,
     opt_mem = cm.OptMemSpec(moments=0)
     kv_fp = kv_spec.fingerprint() if kv_spec is not None else ()
     opt_fp = f"serve-{kind}-{objective}-{kv_fp}"
+    if kv_spec is not None and kv_spec.host_pages > 0:
+        # prefetch-ahead changes the decode pricing, so tiered configs key
+        # separately; untiered fingerprints stay byte-identical to before
+        opt_fp += f"-pf{int(prefetch_ahead)}"
     use_cache = bool(getattr(cfg, "strategy_cache", True))
     cache_dir = sc.resolve_dir(cfg) if use_cache else None
     key = None
@@ -208,8 +240,9 @@ def serving_optimize(smodel: FFModel, machine: MachineSpec, kind: str,
             return cached
     beam = max(8, min(64, int(getattr(cfg, "search_budget", 16) or 16)))
     kv_layer = kv_spec.layer_bytes() if (kv_spec and kind == "decode") else 0
-    cost_fn = (_decode_cost_fn(machine, kv_layer) if kind == "decode"
-               else _prefill_cost_fn(machine))
+    cost_fn = (_decode_cost_fn(machine, kv_layer, kv_spec=kv_spec,
+                               prefetch_ahead=prefetch_ahead)
+               if kind == "decode" else _prefill_cost_fn(machine))
     t0 = time.perf_counter()
     degree = 1
     result = None

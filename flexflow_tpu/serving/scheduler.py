@@ -181,9 +181,20 @@ class ContinuousBatchingScheduler:
             except Exception:  # noqa: BLE001 — untraceable inputs fn
                 self._spec_fused = None
         self._accept_ema = 0.0
+        # tiered KV cache (ISSUE 16): parked requests own their slot id
+        # while their K/V sits in the host tier; rotation happens only at
+        # sync points so a parked slot never has un-materialized window
+        # tokens. prefetch_ahead is the hit/stall classifier AND the lead
+        # the rotation aims for.
+        self.tiered = bool(getattr(self.kv, "host_pages", 0))
+        self.prefetch_ahead = max(1, int(
+            getattr(cfg, "kv_prefetch_ahead", 2) or 2))
+        self.max_context = int(getattr(cfg, "serve_max_context", 0) or 0)
+        self.parked: Dict[int, Request] = {}
         self.stats: Dict[str, int] = {
             "shed_queue_full": 0, "shed_ttft_budget": 0, "shed_deadline": 0,
-            "shed_prompt_too_long": 0, "failed": 0, "evicted_wedged": 0,
+            "shed_prompt_too_long": 0, "shed_over_max_context": 0,
+            "failed": 0, "evicted_wedged": 0,
             "decode_timeouts": 0, "overdecode_tokens": 0, "swaps": 0,
             "spec_rounds": 0, "spec_drafted_tokens": 0,
             "spec_accepted_tokens": 0}
@@ -256,6 +267,22 @@ class ContinuousBatchingScheduler:
             # different request than the one sent
             self._shed(req, "prompt_too_long", now_s)
             return
+        if self.max_context and \
+                len(req.prompt) + req.max_new_tokens > self.max_context:
+            # over the operator-declared context ceiling: permanent, its
+            # own reason — distinct from a transiently full pool, which
+            # queues (backpressure) instead of shedding
+            self._shed(req, "over_max_context", now_s)
+            return
+        need = (len(req.prompt) + req.max_new_tokens
+                + self.dispatch_ahead + self.spec_tokens)
+        if self.kv.pages_needed(need) > self.kv.capacity_pages():
+            # permanent by CAPACITY, not occupancy: no sequence of
+            # evictions/spills frees enough pages across BOTH tiers —
+            # derived from HBM + host (ISSUE 16), where the old check
+            # only ever saw the device pool
+            self._shed(req, "prompt_too_long", now_s)
+            return
         if self.queue_cap and len(waiting) >= self.queue_cap:
             worst = max(waiting, key=_urgency)
             if _urgency(req) < _urgency(worst):
@@ -326,7 +353,10 @@ class ContinuousBatchingScheduler:
             need = (len(req.prompt) + req.max_new_tokens
                     + self.dispatch_ahead + self.spec_tokens)
             if not self.kv.can_admit(need):
-                break  # page backpressure: keep queued
+                # tiered: spill an active slot's pages to the host tier to
+                # make HBM room before conceding backpressure
+                if not (self.tiered and self._make_room(need, active)):
+                    break  # page backpressure: keep queued
             slot = free[0]
             try:
                 run_resilient(
@@ -425,6 +455,105 @@ class ContinuousBatchingScheduler:
                       priority=req.priority, ttft_s=req.ttft_s,
                       queue_wait_s=max(0.0, t_pre_off - req.arrival_s))
         return True
+
+    # ---------------------------------------------------------- tier rotation
+    def _park(self, slot: int, active: Dict[int, Request]) -> None:
+        """Spill one active slot to the host tier. Only called at sync
+        points (the window was just materialized), so the request's token
+        list and the KV position mirrors agree on the committed extent."""
+        req = active.pop(slot)
+        self.kv.spill(slot, self.decode_steps)
+        if self._spec:
+            self.draft.kv.spill(slot, self.decode_steps)
+        self.parked[slot] = req
+        tel.event("serve/slot_parked", cat="serve", rid=req.rid, slot=slot,
+                  tokens=len(req.tokens))
+
+    def _make_room(self, need: int, active: Dict[int, Request]) -> bool:
+        """Spill active slots (largest remaining decode budget first — the
+        fairness heuristic: the request farthest from finishing donates
+        its HBM residency) until `need` pages fit. Spills publish their
+        table/active updates immediately so a failed admission afterwards
+        can never leave a parked slot looking active on device."""
+        spilled = False
+        while not self.kv.can_admit(need):
+            cands = [s for s in active
+                     if self.kv.can_spill(s)
+                     and (not self._spec or self.draft.kv.can_spill(s))]
+            if not cands:
+                break
+            slot = max(cands, key=lambda s: (
+                active[s].max_new_tokens - len(active[s].tokens), -s))
+            self._park(slot, active)
+            spilled = True
+        if spilled:
+            self.kv.push()
+            if self._spec:
+                self.draft.kv.push()
+        return self.kv.can_admit(need)
+
+    def _rotate(self, active: Dict[int, Request], next_host: np.ndarray,
+                now_s: float) -> bool:
+        """One rotation round at a sync point: issue host→HBM prefetches
+        for parked slots (FIFO by park order, as far as device pages
+        allow), then rejoin slots whose prefetch has had `prefetch_ahead`
+        decode steps to land — or immediately when nothing is active (the
+        forced join counts as a stall, never a silent block). Returns True
+        when device state changed (caller refreshes its local handles)."""
+        changed = False
+        for slot in list(self.parked):
+            if slot in self.kv._inflight:
+                continue
+            if not self.kv.prefetch(slot, self.decode_steps):
+                break  # device pages short: retry next sync point
+            if self._spec:
+                self.draft.kv.prefetch(slot, self.decode_steps)
+            changed = True
+        for slot in list(self.parked):
+            issued = self.kv._inflight.get(slot)
+            if issued is None:
+                continue
+            lead = self.decode_steps - issued
+            if lead < self.prefetch_ahead and active:
+                continue  # not ready and decode has other work
+            stalled = self.kv.join(slot, self.decode_steps,
+                                   self.prefetch_ahead)
+            if self._spec:
+                self.draft.kv.join(slot, self.decode_steps,
+                                   self.prefetch_ahead)
+            req = self.parked.pop(slot)
+            # re-seed the decode feedback: the next step consumes the last
+            # committed token at the preserved position — this is what
+            # makes the spill path bitwise-identical to staying resident
+            next_host[slot, 0] = req.tokens[-1]
+            active[slot] = req
+            changed = True
+            if self.tracer is not None:
+                # the parked interval tiles into the request's timeline as
+                # its own stage, charged to the rejoin sync
+                self.tracer.stage(req, "kv_prefetch", now_s,
+                                  stalled=int(stalled),
+                                  pages=len(self.kv._slot_pages.get(slot, ())))
+            tel.event("serve/slot_rejoined", cat="serve", rid=req.rid,
+                      slot=slot, stalled=int(stalled), lead_steps=int(lead))
+        if changed:
+            self.kv.push()
+            if self._spec:
+                self.draft.kv.push()
+            self._emit_tier()
+        return changed
+
+    def _emit_tier(self) -> None:
+        ts = self.kv.tier_stats()
+        tel.counter("serve/kv_tier_hot_pages", ts["kv_hot_pages"],
+                    cat="serve")
+        tel.counter("serve/kv_tier_cold_pages", ts["kv_cold_pages"],
+                    cat="serve")
+        tel.counter("serve/kv_prefetch_hits", ts["kv_prefetch_hits"],
+                    cat="serve")
+        tel.counter("serve/kv_prefetch_stalls", ts["kv_prefetch_stalls"],
+                    cat="serve")
+        tel.counter("serve/kv_spills", ts["kv_spills"], cat="serve")
 
     # ------------------------------------------------------------- finish
     def _finish(self, req: Request, now_s: float) -> None:
@@ -645,7 +774,7 @@ class ContinuousBatchingScheduler:
         window_toks: List[Any] = []  # dispatched, unmaterialized [slots,1]
         window_t0 = time.perf_counter()
 
-        while queue or waiting or active:
+        while queue or waiting or active or self.parked:
             now = self._now()
             while queue and queue[0].arrival_s <= now:
                 self._enqueue(queue.popleft(), waiting, now)
@@ -653,6 +782,7 @@ class ContinuousBatchingScheduler:
             tel.counter("serve/active_slots", len(active), cat="serve")
             want_sync = (len(window_toks) >= self._window_cap(active)
                          or (waiting and self.kv.free_slots())
+                         or bool(self.parked)
                          or not active)
             if want_sync and window_toks:
                 # materialize the dispatched window: one host sync drains
@@ -676,11 +806,23 @@ class ContinuousBatchingScheduler:
                             getattr(self.engine, "active_version", None))
             if waiting:
                 self._shed_stale(waiting, self._now())
+            if self.parked and not window_toks:
+                # tier rotation at this sync point: prefetch-ahead issues +
+                # ready/forced rejoins (forced = active drained, a counted
+                # stall); runs before admission so rejoining slots claim
+                # device pages ahead of new arrivals (they are older)
+                self._rotate(active, next_host, self._now())
             if waiting and self.kv.free_slots():
                 if self._admit(waiting, active, next_host, self._now()):
                     state = self.kv.state
                     next_dev = jnp.asarray(next_host)
                     window_t0 = time.perf_counter()
+            if self.tiered:
+                # rotation/spill mutate device state outside _admit's
+                # refresh; re-anchor unconditionally (untiered runs keep
+                # the exact pre-PR dispatch sequence)
+                state = self.kv.state
+                next_dev = jnp.asarray(next_host)
             if not active:
                 if queue and not waiting:
                     # open loop: idle until the next arrival (short naps
@@ -728,6 +870,11 @@ class ContinuousBatchingScheduler:
                 logits[:, -1, :], axis=-1).astype(jnp.int32)[:, None]
             window_toks.append(next_dev)
             self.decode_steps += 1
+        if self.tiered:
+            # final tier ledger: counters into telemetry (monitor/prom) and
+            # into stats (the bench + tests read them from here)
+            self._emit_tier()
+            self.stats.update(self.kv.tier_stats())
         if self.tracer is not None:
             # publish the live histograms + SLO scoreboard into the
             # telemetry stream (monitor/prom read them from here)

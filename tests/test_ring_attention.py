@@ -13,6 +13,7 @@ from flexflow_tpu.kernels.flash_attention import flash_supported
 from flexflow_tpu.kernels.ring_attention import ring_attention
 from flexflow_tpu.parallel.machine import MachineSpec, build_mesh
 from flexflow_tpu.search.dp import search_graph
+from flexflow_tpu.serving.program import clone_for_serving, serving_optimize
 
 MACH = MachineSpec(mesh_axes={"data": 2, "model": 4}, chip="v5p")
 
@@ -79,6 +80,34 @@ def test_search_selects_ring_past_vmem_budget():
     r2 = search_graph(short, MACH)
     assert not r2.choices["attn"].name.startswith("sp_ring"), \
         r2.choices["attn"].name
+
+
+def _serving_prefill_sharding(seq):
+    cfg = FFConfig(search_budget=16, mesh_shape={"data": 2, "model": 4},
+                   log_level="warning", strategy_cache=False)
+    m = FFModel(cfg)
+    x = m.create_tensor((2, seq, 128), name="x")
+    m.multihead_attention(x, x, x, embed_dim=128, num_heads=2, name="attn")
+    sm, attn = clone_for_serving(m, "prefill", 2)
+    st = serving_optimize(sm, MACH, "prefill", attn)
+    return st.op_shardings.get("attn")
+
+
+def test_serving_prefill_searches_ring_crossover():
+    """The serving prefill search prices the ring path with its
+    forward-only comm volume (no backward hops): past the flash VMEM
+    budget the DP must route prefill to sp_ring with the sequence sharded
+    over the model axis, and below it flash must win — the crossover is
+    found by pricing, not hardcoded."""
+    long_sh = _serving_prefill_sharding(16384)
+    assert long_sh is not None
+    assert long_sh.attrs.get("seq_parallel") == "model", long_sh.attrs
+    assert ["data", "model", None] in [list(o) for o in long_sh.outputs], \
+        long_sh.outputs
+
+    short_sh = _serving_prefill_sharding(512)
+    short_attrs = (short_sh.attrs or {}) if short_sh else {}
+    assert not short_attrs.get("seq_parallel"), short_attrs
 
 
 def test_long_context_trains_seq_sharded(devices):

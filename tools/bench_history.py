@@ -123,6 +123,12 @@ FAMILIES: Dict[str, Tuple[str, Callable[[Dict[str, Any]],
                    ("swaps_completed", "swap_p99_s", "dropped_inflight",
                     "overload_shed", "served_ttft_p99_s", "legs_passed")
                    if d.get(k) is not None]),
+    "longctx": (
+        r"^BENCH_longctx\.json$",
+        lambda d: [(k, float(d[k])) for k in
+                   ("context_gain_vs_hbm_only", "prefetch_hit_rate",
+                    "spill_parity", "ring_crossover", "legs_passed")
+                   if d.get(k) is not None]),
     "slo": (
         r"^BENCH_reqtrace\.json$",
         lambda d: [(k, float(d[k])) for k in

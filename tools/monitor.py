@@ -71,7 +71,11 @@ def gather(events: List[Dict[str, Any]]) -> Dict[str, Any]:
              # ISSUE 15: streaming latency histograms (serve/hist
              # snapshots — merged across segments/processes by
              # _merged_hists) + the last SLO scoreboard
-             "hist_snaps": [], "slo": None}
+             "hist_snaps": [], "slo": None,
+             # ISSUE 16: tiered KV cache counters (latest sample wins —
+             # the scheduler re-emits at every rotation sync point)
+             "kv_hot_pages": None, "kv_cold_pages": None,
+             "kv_prefetch_hits": 0, "kv_prefetch_stalls": 0, "kv_spills": 0}
     for ev in events:
         name = ev.get("name", "")
         args = ev.get("args") or {}
@@ -121,6 +125,16 @@ def gather(events: List[Dict[str, Any]]) -> Dict[str, Any]:
         elif name == "serve/engine":
             serve["kv_dtype"] = args.get("kv_dtype", serve["kv_dtype"])
             serve["spec_tokens"] = int(args.get("spec_tokens") or 0)
+        elif name == "serve/kv_tier_hot_pages":
+            serve["kv_hot_pages"] = int(args.get("value") or 0)
+        elif name == "serve/kv_tier_cold_pages":
+            serve["kv_cold_pages"] = int(args.get("value") or 0)
+        elif name == "serve/kv_prefetch_hits":
+            serve["kv_prefetch_hits"] = int(args.get("value") or 0)
+        elif name == "serve/kv_prefetch_stalls":
+            serve["kv_prefetch_stalls"] = int(args.get("value") or 0)
+        elif name == "serve/kv_spills":
+            serve["kv_spills"] = int(args.get("value") or 0)
         elif name == "serve/hist":
             serve["hist_snaps"].append(args)
         elif name == "serve/slo":
@@ -251,6 +265,17 @@ def _serve_stats(serve: Dict[str, Any]) -> Optional[Dict[str, Any]]:
              if serve.get("spec_drafted") else None)),
         "spec_tokens": serve.get("spec_tokens", 0),
         "kv_dtype": serve.get("kv_dtype"),
+        "kv_hot_pages": serve.get("kv_hot_pages"),
+        "kv_cold_pages": serve.get("kv_cold_pages"),
+        "kv_prefetch_hits": serve.get("kv_prefetch_hits", 0),
+        "kv_prefetch_stalls": serve.get("kv_prefetch_stalls", 0),
+        "kv_spills": serve.get("kv_spills", 0),
+        "kv_prefetch_hit_rate": (
+            serve.get("kv_prefetch_hits", 0)
+            / (serve.get("kv_prefetch_hits", 0)
+               + serve.get("kv_prefetch_stalls", 0))
+            if (serve.get("kv_prefetch_hits", 0)
+                + serve.get("kv_prefetch_stalls", 0)) else None),
     }
 
 
@@ -316,6 +341,15 @@ def render(state: Dict[str, Any]) -> List[str]:
                 f"accepted={sv['spec_accepted']} "
                 f"accept_ema={f(rate, '%.2f')}  "
                 f"kv_dtype={sv['kv_dtype'] or '-'}")
+        if sv["kv_hot_pages"] is not None or sv["kv_spills"]:
+            # ISSUE 16: tiered KV cache — occupancy + prefetch efficiency
+            lines.append(
+                f"kv tier  hot={f(sv['kv_hot_pages'], '%g')} "
+                f"cold={f(sv['kv_cold_pages'], '%g')} pages  "
+                f"spills={sv['kv_spills']} "
+                f"prefetch hit/stall={sv['kv_prefetch_hits']}/"
+                f"{sv['kv_prefetch_stalls']} "
+                f"(hit rate {f(sv['kv_prefetch_hit_rate'], '%.2f')})")
         slo = sv.get("slo")
         if slo and slo.get("objectives"):
             # ISSUE 15: error-budget scoreboard — one compact line per
@@ -450,6 +484,24 @@ def prom_export(state: Dict[str, Any], path: str) -> None:
             gauge("flexflow_serve_spec_accept_rate",
                   float(sv["spec_accept_rate"]),
                   "EMA of the per-round draft acceptance rate")
+        if sv["kv_hot_pages"] is not None or sv["kv_spills"]:
+            # ISSUE 16: tiered KV cache gauges
+            gauge("flexflow_serve_kv_tier_hot_pages",
+                  float(sv["kv_hot_pages"] or 0),
+                  "Allocated HBM-tier KV pages (latest sample)")
+            gauge("flexflow_serve_kv_tier_cold_pages",
+                  float(sv["kv_cold_pages"] or 0),
+                  "Allocated host-tier KV pages (latest sample)")
+            gauge("flexflow_serve_kv_tier_spills_total",
+                  float(sv["kv_spills"]),
+                  "Slot spills HBM -> host tier")
+            gauge("flexflow_serve_kv_prefetch_stalls_total",
+                  float(sv["kv_prefetch_stalls"]),
+                  "Slot rejoins whose host->HBM prefetch lacked lead")
+            if sv["kv_prefetch_hit_rate"] is not None:
+                gauge("flexflow_serve_kv_prefetch_hit_rate",
+                      float(sv["kv_prefetch_hit_rate"]),
+                      "Prefetch hits / (hits + stalls)")
         if sv["kv_dtype"] is not None:
             # dtype rides as a label on a constant-1 gauge (the textfile
             # collector has no string metrics)
