@@ -432,24 +432,46 @@ _TRANSIENT_MARKERS = ("remote_compile", "read body", "UNAVAILABLE",
                       "Connection reset", "Socket closed")
 
 
+def _tunnel_exc_types() -> tuple:
+    """The exception types the tunnel client can actually raise:
+    RuntimeError (jaxlib's XlaRuntimeError subclasses it, and the client
+    wraps stream drops in bare RuntimeErrors — the observed r5 case),
+    OSError (ConnectionError/TimeoutError/socket errors), and — when the
+    transport package is importable — grpc.RpcError, which subclasses
+    neither. The retry loop catches ONLY these; everything else
+    propagates immediately."""
+    types = [RuntimeError, OSError]
+    try:
+        import grpc
+        types.append(grpc.RpcError)
+    except ImportError:
+        pass
+    return tuple(types)
+
+
+_TUNNEL_EXC_TYPES = _tunnel_exc_types()
+
+
 def _is_transient_tunnel_error(e: BaseException) -> bool:
     """The axon tunnel occasionally drops a remote_compile / data stream
     mid-flight (observed r5: 'read body: response body closed before all
     bytes were read'); the next attempt usually succeeds.
 
-    Narrowed (ADVICE r5): the substring probe alone no longer retries —
-    the exception must ALSO be a type the tunnel client can raise:
-    RuntimeError (jaxlib's XlaRuntimeError subclasses it, and the client
-    wraps stream drops in bare RuntimeErrors — the observed r5 case),
-    OSError (ConnectionError/TimeoutError/socket errors), or a type
-    defined in a tunnel-adjacent package (grpc.RpcError etc.). An
-    unrelated ValueError('...UNAVAILABLE') from workload code no longer
-    reruns main() from scratch."""
-    s = f"{type(e).__name__}: {e}"
-    transient = any(m in s for m in _TRANSIENT_MARKERS)
+    Narrowed (ADVICE r5, completed ISSUE 18): the except clause already
+    restricts to _TUNNEL_EXC_TYPES; within those, RuntimeError/OSError
+    are too generic on their own, so the transient-marker substring probe
+    is the fallback confirmation that the failure came off the wire — a
+    RuntimeError raised by workload code without a tunnel signature no
+    longer reruns main() from scratch. Types defined in a tunnel-adjacent
+    package (grpc/axon/jaxlib) pass the type test by provenance and use
+    the same substring probe to split transient from permanent (an auth
+    failure is an RpcError too)."""
     mod = (type(e).__module__ or "").split(".")[0]
-    return transient and (isinstance(e, (RuntimeError, OSError))
-                          or mod in ("jax", "jaxlib", "grpc", "axon"))
+    if not isinstance(e, _TUNNEL_EXC_TYPES) and \
+            mod not in ("jax", "jaxlib", "grpc", "axon"):
+        return False
+    s = f"{type(e).__name__}: {e}"
+    return any(m in s for m in _TRANSIENT_MARKERS)
 
 
 if __name__ == "__main__":
@@ -457,7 +479,7 @@ if __name__ == "__main__":
         try:
             main()
             break
-        except Exception as e:  # noqa: BLE001 — retry transient tunnel drops
+        except _TUNNEL_EXC_TYPES as e:  # transient tunnel drops only
             if _attempt == 2 or not _is_transient_tunnel_error(e):
                 raise
             print(f"transient tunnel error (attempt {_attempt + 1}/3): {e}; "

@@ -297,6 +297,29 @@ class FFConfig:
     kv_host_pages: int = 0
     kv_prefetch_ahead: int = 2
     serve_max_context: int = 0
+    # fleet serving (ISSUE 18): replica pools behind one control plane.
+    #   serve_replicas         — in-process engine replicas behind the
+    #                            fleet router. 1 = the plain pre-fleet
+    #                            single-engine path (no pump threads).
+    #   serve_fleet_topology   — "colocated" (every replica prefills and
+    #                            decodes) or "disagg" (dedicated prefill
+    #                            replicas hand committed KV pages to the
+    #                            decode pool over the host tier; needs
+    #                            kv_host_pages > 0 on every replica).
+    #   serve_prefill_replicas — replicas assigned to the prefill pool
+    #                            under disagg; clamped to [1, replicas-1].
+    #   serve_router           — placement policy: "least_loaded"
+    #                            (outstanding work + estimated TTFT, SLO
+    #                            burn as tie-breaker) or "round_robin".
+    #   serve_rollout_burn_max — rolling-swap rollback ceiling: a swapped
+    #                            replica whose SLO worst burn rate crosses
+    #                            it rolls back and freezes the rollout.
+    #                            0 = no rollback monitor.
+    serve_replicas: int = 1
+    serve_fleet_topology: str = "colocated"
+    serve_prefill_replicas: int = 1
+    serve_router: str = "least_loaded"
+    serve_rollout_burn_max: float = 0.0
 
     REMAT_POLICY_NAMES = ("none", "dots", "full")
 
@@ -455,6 +478,13 @@ class FFConfig:
         p.add_argument("--kv-host-pages", type=int, default=0)
         p.add_argument("--kv-prefetch-ahead", type=int, default=2)
         p.add_argument("--serve-max-context", type=int, default=0)
+        p.add_argument("--serve-replicas", type=int, default=1)
+        p.add_argument("--serve-fleet-topology", type=str,
+                       default="colocated", choices=("colocated", "disagg"))
+        p.add_argument("--serve-prefill-replicas", type=int, default=1)
+        p.add_argument("--serve-router", type=str, default="least_loaded",
+                       choices=("least_loaded", "round_robin"))
+        p.add_argument("--serve-rollout-burn-max", type=float, default=0.0)
         return p
 
     @staticmethod
@@ -574,4 +604,9 @@ class FFConfig:
             kv_host_pages=args.kv_host_pages,
             kv_prefetch_ahead=args.kv_prefetch_ahead,
             serve_max_context=args.serve_max_context,
+            serve_replicas=args.serve_replicas,
+            serve_fleet_topology=args.serve_fleet_topology,
+            serve_prefill_replicas=args.serve_prefill_replicas,
+            serve_router=args.serve_router,
+            serve_rollout_burn_max=args.serve_rollout_burn_max,
         )

@@ -7,9 +7,13 @@ decode loop. Entry point: `compile_serving(model)`.
 """
 
 from flexflow_tpu.serving.engine import ServingCompiled, compile_serving
+from flexflow_tpu.serving.fleet import (AdmissionControl, FleetRouter,
+                                        RollingSwapController, ServingFleet,
+                                        merge_histograms, merge_slo_trackers)
 from flexflow_tpu.serving.kv_cache import (ACTIVE_KEY, KVPoolExhausted,
                                            PAGE_TABLE_KEY, POS_KEY,
-                                           PagedKVCache)
+                                           PagedKVCache,
+                                           derive_prefetch_ahead)
 from flexflow_tpu.serving.program import clone_for_serving, serving_optimize
 from flexflow_tpu.serving.reqtrace import (RequestTracer, StreamingHistogram,
                                            TERMINAL_FIELDS, terminal_record)
@@ -24,4 +28,7 @@ __all__ = [
     "PAGE_TABLE_KEY", "POS_KEY", "ACTIVE_KEY",
     "RequestTracer", "StreamingHistogram", "TERMINAL_FIELDS",
     "terminal_record",
+    "ServingFleet", "AdmissionControl", "FleetRouter",
+    "RollingSwapController", "merge_histograms", "merge_slo_trackers",
+    "derive_prefetch_ahead",
 ]

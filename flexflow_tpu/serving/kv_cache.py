@@ -207,7 +207,7 @@ class PagedKVCache:
         self.tier_counters: Dict[str, int] = {
             "kv_spills": 0, "kv_refills": 0, "kv_prefetch_hits": 0,
             "kv_prefetch_stalls": 0, "kv_spilled_bytes": 0,
-            "kv_refilled_bytes": 0}
+            "kv_refilled_bytes": 0, "kv_handoffs": 0, "kv_handoff_bytes": 0}
         self._push_tables()
 
     # ------------------------------------------------------------ host ops
@@ -427,6 +427,58 @@ class PagedKVCache:
             self.tier_counters["kv_prefetch_hits"] += 1
         return stalled
 
+    # ------------------------------------------------------ replica handoff
+    def export_parked(self, slot: int) -> Dict:
+        """Serialize a PARKED slot's host-tier K/V + committed position for
+        a cross-replica handoff (prefill/decode disaggregation, ISSUE 18):
+        the prefill replica spills the slot after commit, exports it here,
+        evicts, and the fleet delivers the payload to a decode replica's
+        `import_parked`. Non-destructive — the caller evicts afterwards."""
+        host_ids = self._cold.get(slot)
+        if host_ids is None:
+            raise ValueError(f"slot {slot} is not parked (spill it first)")
+        return {
+            "pos": int(self._pos[slot]),
+            "pages": len(host_ids),
+            "layers": {n: {key: buf[host_ids].copy()
+                           for key, buf in self._host[n].items()}
+                       for n in self.attn_layers},
+        }
+
+    def can_import(self, payload: Dict) -> bool:
+        return bool(self.host_pages) and \
+            len(self.free_host_pages) >= int(payload["pages"])
+
+    def import_parked(self, slot: int, payload: Dict) -> None:
+        """Adopt a handed-off slot into this cache's host tier (the decode
+        side of the disaggregated handoff). The slot lands PARKED with its
+        position preserved, so the ordinary rotation (prefetch + join)
+        carries it into HBM — the handoff rides the exact spill/prefetch
+        path and stays bitwise-identical to a colocated prefill. The copy
+        is priced and emitted as a `kv_transfer` op/attr row (direction
+        "handoff") so the learned model refits the DCN/host link like any
+        other op. Raises `KVPoolExhausted` when the host free list is
+        short — backpressure, the fleet retries the delivery."""
+        import time as _time
+        if self._active[slot] or slot in self._cold:
+            raise ValueError(f"slot {slot} is occupied")
+        need = int(payload["pages"])
+        if not self.can_import(payload):
+            raise KVPoolExhausted(slot, need, len(self.free_host_pages))
+        t0 = _time.perf_counter()
+        host_ids = [self.free_host_pages.pop() for _ in range(need)]
+        for n in self.attn_layers:
+            for key, rows in payload["layers"][n].items():
+                self._host[n][key][host_ids] = rows
+        self._cold[slot] = host_ids
+        self._pos[slot] = int(payload["pos"])
+        self._table[slot] = 0
+        self._active[slot] = 0
+        moved = self.spec.layers * need * self.spec.page_bytes()
+        self.tier_counters["kv_handoffs"] += 1
+        self.tier_counters["kv_handoff_bytes"] += moved
+        self._transfer_row("handoff", need, _time.perf_counter() - t0)
+
     def tier_stats(self) -> Dict[str, int]:
         """Counters + occupancy snapshot for telemetry/monitoring."""
         hot = (self.spec.pool_pages - 1) - len(self.free_pages)
@@ -470,3 +522,64 @@ class PagedKVCache:
                     total += sum(s.data.nbytes for s in shards
                                  if s.device == dev)
         return total
+
+
+# -------------------------------------------------- prefetch-ahead autotune
+def learned_kv_transfer_seconds(cfg, spec: KVCacheSpec,
+                                quantized: bool = False, machine=None,
+                                pages: Optional[int] = None
+                                ) -> Optional[float]:
+    """Learned seconds for one slot-sized host↔HBM transfer, or None when
+    no learned model resolves a `kv_transfer` prediction (no model file on
+    the resolution chain, or the model never saw the kind). Features are
+    built exactly like `PagedKVCache._transfer_row` emits them, so the
+    coefficient refit from serving telemetry prices this query."""
+    import os
+    try:
+        from flexflow_tpu.search.learned_cost import (LearnedCostModel,
+                                                      resolve_model_path)
+        from flexflow_tpu.search import memo
+    except ImportError:
+        return None
+    path = resolve_model_path(cfg)
+    if not path or not os.path.isfile(path):
+        return None
+    try:
+        model = LearnedCostModel.load(path)
+    except Exception:  # noqa: BLE001 — a corrupt model never breaks serving
+        return None
+    n_pages = int(pages if pages is not None else spec.pages_per_slot)
+    moved = spec.layers * n_pages * spec.page_bytes()
+    host_bw = getattr(machine, "host_bw", 0.0) or 16e9
+    predicted = moved / host_bw
+    features = {
+        "op": "kv_transfer",
+        "in_shapes": [[n_pages, spec.page_size, spec.heads, spec.head_dim]],
+        "out_shapes": [[n_pages, spec.page_size, spec.heads, spec.head_dim]],
+        "weight_shapes": [],
+        "dtype": "int8" if quantized else "float32",
+        "params": 0,
+        "layout": "prefetch",
+        "sharding": {"out": [], "weights": []},
+        "machine": (memo.machine_fingerprint(machine)
+                    if machine is not None else ()),
+    }
+    try:
+        return model.predict_features(features, predicted_s=predicted,
+                                      roofline_s=predicted)
+    except Exception:  # noqa: BLE001
+        return None
+
+
+def derive_prefetch_ahead(transfer_s: Optional[float],
+                          decode_step_s: Optional[float],
+                          fallback: int) -> int:
+    """The rotation lead (in decode steps) that hides one slot refill
+    behind decode compute: ceil(learned transfer time / decode step time),
+    clamped to [1, 64]. Falls back to the `--kv-prefetch-ahead` flag value
+    when either side of the ratio is unavailable — the flag is the
+    fallback, not the authority (ISSUE 18 satellite)."""
+    if not transfer_s or not decode_step_s or decode_step_s <= 0:
+        return max(1, int(fallback))
+    return max(1, min(64, -(-int(transfer_s * 1e9)
+                            // max(1, int(decode_step_s * 1e9)))))

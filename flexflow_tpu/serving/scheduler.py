@@ -58,6 +58,19 @@ loop calls `engine.poll_swap()` only while the dispatched window is
 empty — the swap's pointer flip happens BETWEEN decode steps, with no
 in-flight dispatch referencing the retiring param tree.
 
+Fleet integration (ISSUE 18): this class is the REPLICA-LOCAL decode
+loop. The admission policy brain (shed-or-queue, queue-cap displacement,
+staleness sweeps) lives in `fleet.AdmissionControl` — one instance here
+for standalone use, the same class at fleet level for cross-replica
+admission — and three hooks let `fleet.ServingFleet` drive N loops:
+`self.feed` (a thread-safe arrival feed replacing the static trace),
+`self.control` (swap orchestration at the between-windows safe point,
+replacing the local `poll_swap` call), and the `handoff` callback
+(prefill-only mode: admitted slots are spilled, exported, and handed to
+the decode pool right after their TTFT materialization). All three
+default to off, and every fleet branch is guarded on them — a standalone
+scheduler is bitwise the pre-fleet loop.
+
 Model specifics stay out of the loop: `prompt_inputs_fn` and
 `step_inputs_fn` adapt token ids + cache state to the model's input list
 (gpt2 adapters below; the generic transformer feeds embeddings directly
@@ -67,6 +80,7 @@ and drives the engine without this scheduler).
 from __future__ import annotations
 
 import dataclasses
+import threading
 import time
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional
@@ -77,7 +91,9 @@ import numpy as np
 
 from flexflow_tpu import telemetry as tel
 from flexflow_tpu.runtime.resilience import RetryPolicy, run_resilient
-from flexflow_tpu.serving.kv_cache import KVPoolExhausted, POS_KEY
+from flexflow_tpu.serving.kv_cache import (KVPoolExhausted, POS_KEY,
+                                           derive_prefetch_ahead,
+                                           learned_kv_transfer_seconds)
 from flexflow_tpu.serving.reqtrace import RequestTracer, terminal_record
 
 
@@ -130,7 +146,8 @@ class ContinuousBatchingScheduler:
                  decode_timeout_ms: Optional[float] = None,
                  prefill_chunk_tokens: int = 0,
                  retry_policy: Optional[RetryPolicy] = None,
-                 reqtrace: Optional[bool] = None):
+                 reqtrace: Optional[bool] = None,
+                 handoff: Optional[Callable] = None):
         self.engine = engine
         self.params = params
         self.prompt_inputs_fn = prompt_inputs_fn
@@ -189,7 +206,45 @@ class ContinuousBatchingScheduler:
         self.tiered = bool(getattr(self.kv, "host_pages", 0))
         self.prefetch_ahead = max(1, int(
             getattr(cfg, "kv_prefetch_ahead", 2) or 2))
+        # autotuned prefetch-ahead (ISSUE 18 satellite): when a learned
+        # model resolves a kv_transfer prediction for this cache geometry,
+        # the lead is re-derived from it at the first measured decode step
+        # — the flag value above is the fallback, not the authority
+        self._autotune_transfer_s: Optional[float] = None
+        self._autotuned = False
+        if self.tiered:
+            self._autotune_transfer_s = learned_kv_transfer_seconds(
+                cfg, self.kv.spec, quantized=self.kv.quantized,
+                machine=self.kv.machine)
         self.max_context = int(getattr(cfg, "serve_max_context", 0) or 0)
+        # the admission policy brain is the fleet-level class (ISSUE 18
+        # control-plane split); a standalone scheduler owns one instance
+        from flexflow_tpu.serving.fleet import AdmissionControl
+        self.admission = AdmissionControl(
+            seq=self.seq, max_context=self.max_context,
+            queue_cap=self.queue_cap, ttft_budget_ms=self.ttft_budget_ms,
+            overhead_tokens=self.dispatch_ahead + self.spec_tokens,
+            pages_needed=self.kv.pages_needed,
+            capacity_pages=self.kv.capacity_pages)
+        # fleet hooks (all default-off; see module docstring)
+        self.feed = None                    # fleet-injected arrival feed
+        self.control = None                 # fleet swap orchestration
+        self.handoff = handoff              # prefill-only: route to decode
+        # device-execution serialization: standalone, a private (never
+        # contended) lock — zero behavior change. Under an in-process
+        # fleet this is the fleet-wide RLock and _exec_serialized=True
+        # adds run-to-completion barriers, because concurrent collective
+        # programs from sibling replicas deadlock the shared XLA runtime
+        # (see fleet._SharedRuntimeEngine).
+        self.exec_lock: Any = threading.RLock()
+        self._exec_serialized = False
+        if self.handoff is not None and self._spec:
+            raise ValueError("prefill-only handoff does not compose with "
+                             "speculative decoding (no draft-cache handoff)")
+        self.handoffs = 0
+        self._pending_handoffs: List = []   # (Request, payload) to ingest
+        self.queue_depth = 0                # live router signals (ints,
+        self.active_count = 0               # safe to read cross-thread)
         self.parked: Dict[int, Request] = {}
         self.stats: Dict[str, int] = {
             "shed_queue_full": 0, "shed_ttft_budget": 0, "shed_deadline": 0,
@@ -258,56 +313,29 @@ class ContinuousBatchingScheduler:
 
     def _enqueue(self, req: Request, waiting: List[Request],
                  now_s: float) -> None:
-        """The shed-or-queue decision for one arrival."""
+        """The shed-or-queue decision for one arrival. The decisions
+        themselves live in `fleet.AdmissionControl` (the PR 11 machinery,
+        lifted to where the fleet can share it); this wrapper keeps the
+        side effects — tracing, shed telemetry, terminal records — on the
+        replica that owns the request."""
         if self.tracer is not None:
             self.tracer.on_submit(req, now_s)
-        if len(req.prompt) > self.seq:
-            # can NEVER be admitted: the prefill program's window is fixed
-            # at `seq`; silently truncating the prompt would serve a
-            # different request than the one sent
-            self._shed(req, "prompt_too_long", now_s)
+        reason = self.admission.permanent_shed_reason(req)
+        if reason is not None:
+            self._shed(req, reason, now_s)
             return
-        if self.max_context and \
-                len(req.prompt) + req.max_new_tokens > self.max_context:
-            # over the operator-declared context ceiling: permanent, its
-            # own reason — distinct from a transiently full pool, which
-            # queues (backpressure) instead of shedding
-            self._shed(req, "over_max_context", now_s)
-            return
-        need = (len(req.prompt) + req.max_new_tokens
-                + self.dispatch_ahead + self.spec_tokens)
-        if self.kv.pages_needed(need) > self.kv.capacity_pages():
-            # permanent by CAPACITY, not occupancy: no sequence of
-            # evictions/spills frees enough pages across BOTH tiers —
-            # derived from HBM + host (ISSUE 16), where the old check
-            # only ever saw the device pool
-            self._shed(req, "prompt_too_long", now_s)
-            return
-        if self.queue_cap and len(waiting) >= self.queue_cap:
-            worst = max(waiting, key=_urgency)
-            if _urgency(req) < _urgency(worst):
-                waiting.remove(worst)
-                self._shed(worst, "queue_full", now_s)
-                waiting.append(req)
-            else:
-                self._shed(req, "queue_full", now_s)
-            return
-        waiting.append(req)
+        victim = self.admission.queue_or_displace(req, waiting)
+        if victim is not None:
+            self._shed(victim, "queue_full", now_s)
 
     def _shed_stale(self, waiting: List[Request], now_s: float) -> None:
         """Deadline/TTFT-budget sweep: shed waiters that can no longer be
         served in time (their elapsed wait plus the EMA prefill service
         time already blows the budget) — serving them would burn slots on
         dead-on-arrival responses."""
-        for r in list(waiting):
-            waited_ms = 1e3 * (now_s - r.arrival_s)
-            if r.deadline_s is not None and now_s > r.arrival_s + r.deadline_s:
-                waiting.remove(r)
-                self._shed(r, "deadline", now_s)
-            elif self.ttft_budget_ms and \
-                    waited_ms + self._ema_serve_ms > self.ttft_budget_ms:
-                waiting.remove(r)
-                self._shed(r, "ttft_budget", now_s)
+        for r, reason in self.admission.stale(waiting, now_s,
+                                              self._ema_serve_ms):
+            self._shed(r, reason, now_s)
 
     def _pick_wedged(self, active: Dict[int, Request]) -> int:
         """Deterministic eviction choice for a wedged/faulted decode
@@ -543,6 +571,72 @@ class ContinuousBatchingScheduler:
             self._emit_tier()
         return changed
 
+    def _maybe_autotune(self, decode_step_s: float) -> None:
+        """First measured decode step closes the autotune loop: the lead
+        becomes ceil(learned kv_transfer seconds / measured step seconds)
+        — the number of steps a slot refill actually needs to hide behind
+        decode compute on THIS machine, per the refit host-link
+        coefficient. No learned model resolved -> `self._autotune_transfer_s`
+        is None and the flag value stays authoritative."""
+        if self._autotune_transfer_s is None or self._autotuned:
+            return
+        self._autotuned = True
+        tuned = derive_prefetch_ahead(self._autotune_transfer_s,
+                                      decode_step_s, self.prefetch_ahead)
+        tel.event("serve/kv_prefetch_autotune", cat="serve",
+                  learned_transfer_s=float(self._autotune_transfer_s),
+                  decode_step_s=float(decode_step_s),
+                  prefetch_ahead=int(tuned),
+                  fallback=int(self.prefetch_ahead))
+        self.prefetch_ahead = tuned
+
+    # -------------------------------------------------- disaggregated handoff
+    def _handoff_all(self, active: Dict[int, Request]) -> None:
+        """Prefill-only mode (ISSUE 18 `--serve-fleet-topology disagg`):
+        right after the TTFT materialization, every admitted slot is
+        spilled to the host tier, its committed K/V exported, and the
+        request handed to the fleet's decode pool via the `handoff`
+        callback. A slot that cannot spill (host pages short) simply stays
+        and decodes locally — colocated fallback, never a drop."""
+        moved = False
+        for slot in list(active):
+            if not self.kv.can_spill(slot):
+                continue
+            req = active.pop(slot)
+            self.kv.spill(slot, self.decode_steps)
+            payload = self.kv.export_parked(slot)
+            self.kv.evict(slot)
+            req.slot = None
+            moved = True
+            self.handoffs += 1
+            tel.event("serve/request_handoff", cat="serve", rid=req.rid,
+                      pages=int(payload["pages"]), tokens=len(req.tokens))
+            self.handoff(req, payload)
+        if moved:
+            self.kv.push()
+
+    def _ingest_handoffs(self, now_s: float) -> None:
+        """Decode-side of the handoff: adopt each pending payload into the
+        host tier as a PARKED slot (position preserved), so the ordinary
+        rotation prefetches + rejoins it — bitwise the spill path. A short
+        host free list keeps the payload pending (backpressure, retried at
+        the next sync point)."""
+        still: List = []
+        for req, payload in self._pending_handoffs:
+            free = self.kv.free_slots()
+            if not free or not self.kv.can_import(payload):
+                still.append((req, payload))
+                continue
+            slot = free[0]
+            self.kv.import_parked(slot, payload)
+            req.slot = slot
+            self.parked[slot] = req
+            if self.tracer is not None:
+                self.tracer.on_submit(req, now_s)
+            tel.event("serve/request_adopted", cat="serve", rid=req.rid,
+                      slot=slot, pages=int(payload["pages"]))
+        self._pending_handoffs = still
+
     def _emit_tier(self) -> None:
         ts = self.kv.tier_stats()
         tel.counter("serve/kv_tier_hot_pages", ts["kv_hot_pages"],
@@ -605,6 +699,7 @@ class ContinuousBatchingScheduler:
         self.materializations += 1
         per_step = (t_now - window_t0) / steps
         self.step_times.extend([per_step] * steps)
+        self._maybe_autotune(per_step)
         adv = np.zeros((self.slots,), np.int32)
         finished: List[int] = []
         for slot, req in active.items():
@@ -659,33 +754,38 @@ class ContinuousBatchingScheduler:
         cleanly off the unchanged host mirrors."""
         K = self.spec_tokens
         t0 = time.perf_counter()
-        dstate = self.draft.kv.state
-        tstate = self.kv.state
-        last = jnp.asarray(next_host)
-        if self._spec_fused is not None:
-            # the whole round is ONE program launch (see
-            # engine.build_spec_program) — the draft chain's argmax
-            # feedback never leaves the device
-            t_pred_dev, ver_in, tstate, dstate = self.engine.spec_round_step(
-                self.params, self.draft.params, tstate, dstate, last,
-                self.step_inputs_fn)
-        else:
-            # unfused fallback (untraceable step_inputs_fn): K+1 launches
-            cur = last
-            drafts = []
-            for _ in range(K):
-                dlogits, dstate = self.draft.decode_step(
-                    self.draft.params, dstate,
-                    self.step_inputs_fn(cur, dstate))
-                cur = jnp.argmax(dlogits[:, -1, :], axis=-1).astype(
-                    jnp.int32)[:, None]
-                drafts.append(cur)
-            ver_in = jnp.concatenate([last] + drafts, axis=1)  # [slots, K+1]
-            vlogits, tstate = self.engine.verify_step(
-                self.params, tstate, self.step_inputs_fn(ver_in, tstate))
-            t_pred_dev = jnp.argmax(vlogits, axis=-1).astype(jnp.int32)
-        t_pred = np.asarray(t_pred_dev)
-        drafted = np.asarray(ver_in)[:, 1:]                  # [slots, K]
+        with self.exec_lock:
+            dstate = self.draft.kv.state
+            tstate = self.kv.state
+            last = jnp.asarray(next_host)
+            if self._spec_fused is not None:
+                # the whole round is ONE program launch (see
+                # engine.build_spec_program) — the draft chain's argmax
+                # feedback never leaves the device
+                t_pred_dev, ver_in, tstate, dstate = \
+                    self.engine.spec_round_step(
+                        self.params, self.draft.params, tstate, dstate,
+                        last, self.step_inputs_fn)
+            else:
+                # unfused fallback (untraceable step_inputs_fn): K+1
+                # launches
+                cur = last
+                drafts = []
+                for _ in range(K):
+                    dlogits, dstate = self.draft.decode_step(
+                        self.draft.params, dstate,
+                        self.step_inputs_fn(cur, dstate))
+                    cur = jnp.argmax(dlogits[:, -1, :], axis=-1).astype(
+                        jnp.int32)[:, None]
+                    drafts.append(cur)
+                ver_in = jnp.concatenate([last] + drafts, axis=1)
+                vlogits, tstate = self.engine.verify_step(
+                    self.params, tstate, self.step_inputs_fn(ver_in, tstate))
+                t_pred_dev = jnp.argmax(vlogits, axis=-1).astype(jnp.int32)
+            t_pred = np.asarray(t_pred_dev)
+            drafted = np.asarray(ver_in)[:, 1:]              # [slots, K]
+            if self._exec_serialized:
+                jax.block_until_ready((tstate, dstate))
         wall = time.perf_counter() - t0
         self.materializations += 1
         t_end_off = (t0 + wall) - self._t0
@@ -741,6 +841,7 @@ class ContinuousBatchingScheduler:
         tel.counter("serve/spec_accept_rate", self._accept_ema, cat="serve")
         per_tok = wall / max_commit
         self.step_times.extend([per_tok] * max_commit)
+        self._maybe_autotune(per_tok)
         if self.tracer is not None:
             self.tracer.hists["decode_step"].add(per_tok, n=max_commit)
         self.decode_steps += K + 1
@@ -774,15 +875,28 @@ class ContinuousBatchingScheduler:
         window_toks: List[Any] = []  # dispatched, unmaterialized [slots,1]
         window_t0 = time.perf_counter()
 
-        while queue or waiting or active or self.parked:
+        while (queue or waiting or active or self.parked
+               or self._pending_handoffs
+               or (self.feed is not None and not self.feed.exhausted)):
             now = self._now()
+            if self.feed is not None:
+                # fleet feed: the router delivers arrivals (and handed-off
+                # prefill payloads) while the loop runs
+                for item in self.feed.drain():
+                    if isinstance(item, tuple):
+                        self._pending_handoffs.append(item)
+                    else:
+                        self._enqueue(item, waiting, now)
             while queue and queue[0].arrival_s <= now:
                 self._enqueue(queue.popleft(), waiting, now)
+            self.queue_depth = len(waiting)
+            self.active_count = len(active)
             tel.counter("serve/queue_depth", len(waiting), cat="serve")
             tel.counter("serve/active_slots", len(active), cat="serve")
             want_sync = (len(window_toks) >= self._window_cap(active)
                          or (waiting and self.kv.free_slots())
                          or bool(self.parked)
+                         or bool(self._pending_handoffs)
                          or not active)
             if want_sync and window_toks:
                 # materialize the dispatched window: one host sync drains
@@ -792,9 +906,15 @@ class ContinuousBatchingScheduler:
                 window_toks = []
                 state = self.kv.state
                 window_t0 = time.perf_counter()
-            if not window_toks and self.engine.watching:
-                # safe swap point: nothing dispatched references params
-                if self.engine.poll_swap():
+            if not window_toks and (self.control is not None
+                                    or self.engine.watching):
+                # safe swap point: nothing dispatched references params.
+                # Under a fleet, the rolling controller decides whether
+                # THIS replica may advance (or must roll back) here.
+                swapped = (self.control.at_safe_point(self)
+                           if self.control is not None
+                           else self.engine.poll_swap())
+                if swapped:
                     self.params = self.engine.params
                     self.stats["swaps"] += 1
                     state = self.kv.state
@@ -806,6 +926,10 @@ class ContinuousBatchingScheduler:
                             getattr(self.engine, "active_version", None))
             if waiting:
                 self._shed_stale(waiting, self._now())
+            if self._pending_handoffs and not window_toks:
+                # disaggregated decode side: adopt handed-off prefills into
+                # the host tier; the rotation below carries them to HBM
+                self._ingest_handoffs(self._now())
             if self.parked and not window_toks:
                 # tier rotation at this sync point: prefetch-ahead issues +
                 # ready/forced rejoins (forced = active drained, a counted
@@ -817,10 +941,18 @@ class ContinuousBatchingScheduler:
                     state = self.kv.state
                     next_dev = jnp.asarray(next_host)
                     window_t0 = time.perf_counter()
-            if self.tiered:
+            if self.handoff is not None and active and not window_toks:
+                # prefill replica: everything admitted leaves for the
+                # decode pool right after its TTFT materialization
+                self._handoff_all(active)
+                state = self.kv.state
+            if self.tiered and not window_toks:
                 # rotation/spill mutate device state outside _admit's
-                # refresh; re-anchor unconditionally (untiered runs keep
-                # the exact pre-PR dispatch sequence)
+                # refresh; re-anchor at drained-window points only — with
+                # steps in flight the local `state` is AHEAD of the pool
+                # mirror, and resetting to it would re-dispatch the last
+                # materialized token (untiered runs keep the exact pre-PR
+                # dispatch sequence)
                 state = self.kv.state
                 next_dev = jnp.asarray(next_host)
             if not active:
@@ -828,8 +960,15 @@ class ContinuousBatchingScheduler:
                     # open loop: idle until the next arrival (short naps
                     # when watching, so snapshot polls keep happening)
                     wait = max(0.0, queue[0].arrival_s - self._now())
-                    time.sleep(min(wait, 0.05) if self.engine.watching
+                    time.sleep(min(wait, 0.05)
+                               if (self.engine.watching
+                                   or self.control is not None)
                                else wait)
+                elif self.feed is not None and not waiting \
+                        and not self.parked and not self._pending_handoffs:
+                    # fed loop with nothing in hand: nap instead of
+                    # spinning on the (still open) feed
+                    time.sleep(0.002)
                 continue
             if self._spec:
                 # speculative rounds are self-contained (draft chain +
@@ -866,8 +1005,16 @@ class ContinuousBatchingScheduler:
                 next_dev = jnp.asarray(next_host)
                 window_t0 = time.perf_counter()
                 continue
-            next_dev = jnp.argmax(
-                logits[:, -1, :], axis=-1).astype(jnp.int32)[:, None]
+            with self.exec_lock:
+                # the argmax over model-sharded logits is its own collective
+                # program; under a fleet it must not interleave with a
+                # sibling replica's collectives (the engine call above
+                # serializes inside the proxy — this is the one launch the
+                # scheduler itself owns)
+                next_dev = jnp.argmax(
+                    logits[:, -1, :], axis=-1).astype(jnp.int32)[:, None]
+                if self._exec_serialized:
+                    jax.block_until_ready(next_dev)
             window_toks.append(next_dev)
             self.decode_steps += 1
         if self.tiered:

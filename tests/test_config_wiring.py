@@ -301,6 +301,43 @@ def test_slo_reqtrace_flags_wired():
     assert "--serve-reqtrace" not in vf
 
 
+def test_fleet_flags_wired():
+    """The ISSUE-18 fleet knobs flow parse_args -> FFConfig via
+    build_parser only: replica count, colocated/disagg topology split,
+    prefill-pool size, router policy (choices-validated), and the rolling
+    rollout's rollback burn ceiling. All default to the single-replica
+    colocated fleet — behaviorally identical to the pre-fleet scheduler."""
+    import pytest
+
+    from flexflow_tpu.config import FFConfig as Cfg
+
+    cfg = Cfg.parse_args(["--serve-replicas", "4",
+                          "--serve-fleet-topology", "disagg",
+                          "--serve-prefill-replicas", "2",
+                          "--serve-router", "round_robin",
+                          "--serve-rollout-burn-max", "2.0"])
+    assert cfg.serve_replicas == 4
+    assert cfg.serve_fleet_topology == "disagg"
+    assert cfg.serve_prefill_replicas == 2
+    assert cfg.serve_router == "round_robin"
+    assert cfg.serve_rollout_burn_max == 2.0
+    d = Cfg()
+    assert d.serve_replicas == 1                  # one replica = no fleet
+    assert d.serve_fleet_topology == "colocated"  # every replica does both
+    assert d.serve_prefill_replicas == 1
+    assert d.serve_router == "least_loaded"
+    assert d.serve_rollout_burn_max == 0.0        # 0 = never roll back
+    with pytest.raises(SystemExit):
+        Cfg.parse_args(["--serve-fleet-topology", "sharded"])
+    with pytest.raises(SystemExit):
+        Cfg.parse_args(["--serve-router", "random"])
+    vf = Cfg.launcher_value_flags()
+    for flag in ("--serve-replicas", "--serve-fleet-topology",
+                 "--serve-prefill-replicas", "--serve-router",
+                 "--serve-rollout-burn-max"):
+        assert flag in vf, flag
+
+
 def test_fault_plan_flag_arms_injector(devices):
     """--fault-plan reaches runtime/faults.py at compile time (the same
     hook order as --telemetry-dir): a bad plan fails loud at compile, a

@@ -1,0 +1,358 @@
+"""ISSUE 18 — disaggregated serving fleet.
+
+Covers the control-plane pieces in isolation (no engines): the lifted
+AdmissionControl policy brain, exact cross-replica histogram merges, the
+merged SLO scoreboard vs a union-fed tracker, the least-loaded/burn-aware
+router, rolling-swap cursor gating + rollback-on-burn, and the autotuned
+`--kv-prefetch-ahead` derivation (flag = fallback, learned model =
+authority). tools/bench_fleet.py --check rides along as the CI smoke of
+the real-engine paths: single-replica bitwise identity vs the pre-fleet
+scheduler, weak scaling, disagg prefill->decode KV handoff parity, and a
+zero-drop rolling rollout.
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+from flexflow_tpu.health import SLOTracker, parse_slo
+from flexflow_tpu.serving import (AdmissionControl, FleetRouter,
+                                  Request, RollingSwapController,
+                                  derive_prefetch_ahead, merge_histograms,
+                                  merge_slo_trackers)
+from flexflow_tpu.serving.fleet import ReplicaHandle
+from flexflow_tpu.serving.reqtrace import StreamingHistogram
+
+
+# ------------------------------------------------------------- aggregation
+def test_hist_merge_matches_pooled_bucket_for_bucket(rng):
+    """The fleet's cross-replica histogram merge is EXACT: fixed shared
+    bucket edges make merged counts identical — bucket for bucket — to one
+    histogram fed the pooled samples, so fleet p99s are the true fleet
+    quantiles, not an approximation over per-replica summaries."""
+    per_replica = [np.abs(rng.lognormal(-3.0, 1.5, size=n))
+                   for n in (137, 41, 260)]
+    hists = []
+    for samples in per_replica:
+        h = StreamingHistogram()
+        h.add_many(samples)
+        hists.append(h)
+    merged = merge_histograms(hists)
+    pooled = StreamingHistogram()
+    pooled.add_many(np.concatenate(per_replica))
+    assert np.array_equal(merged.counts, pooled.counts)
+    assert merged.count == pooled.count
+    assert merged.sum == pytest.approx(pooled.sum)
+    for q in (0.5, 0.9, 0.99):
+        assert merged.quantile(q) == pooled.quantile(q)
+    # merging never mutates the per-replica sources' identity semantics:
+    # the originals still hold only their own counts
+    assert sum(h.count for h in hists) == merged.count
+
+
+def _rec(outcome="done", ttft_s=None):
+    rec = {"outcome": outcome}
+    if ttft_s is not None:
+        rec["ttft_s"] = ttft_s
+    return rec
+
+
+def test_merged_slo_matches_union_fed_tracker():
+    """merge_slo_trackers rebuilds the scoreboard a single tracker would
+    hold had it seen the union of every replica's terminal records:
+    totals, outcome tallies, windowed burn rates, and budgets all match a
+    union-fed tracker exactly (events interleave by timestamp)."""
+    objectives = parse_slo("ttft_p90_ms=100,availability=0.9")
+    # two replicas observing interleaved streams (explicit now_s so the
+    # window math is deterministic)
+    stream_a = [(1.0, _rec(ttft_s=0.05)), (3.0, _rec(ttft_s=0.25)),
+                (5.0, _rec("shed")), (7.0, _rec(ttft_s=0.08))]
+    stream_b = [(2.0, _rec(ttft_s=0.15)), (4.0, _rec(ttft_s=0.04)),
+                (6.0, _rec("failed")), (8.0, _rec(ttft_s=0.30))]
+    ta = SLOTracker(dict(objectives))
+    tb = SLOTracker(dict(objectives))
+    for ts, rec in stream_a:
+        ta.observe(rec, now_s=ts)
+    for ts, rec in stream_b:
+        tb.observe(rec, now_s=ts)
+    merged = merge_slo_trackers([ta, tb, None])  # None slots are skipped
+    union = SLOTracker(dict(objectives))
+    for ts, rec in sorted(stream_a + stream_b):
+        union.observe(rec, now_s=ts)
+    now = 10.0
+    assert merged.report(now_s=now) == union.report(now_s=now)
+    assert merged.requests == 8
+    assert merged.outcomes == union.outcomes
+    # and the merged events really are time-ordered (the window walk
+    # assumes it)
+    ts_seq = [ts for ts, _ in merged.events]
+    assert ts_seq == sorted(ts_seq)
+
+
+def test_merge_slo_trackers_empty_pool():
+    merged = merge_slo_trackers([None, None])
+    assert merged.requests == 0
+    assert merged.report(now_s=0.0)["objectives"] == {}
+
+
+# ---------------------------------------------------------- admission brain
+def _req(rid, prompt_len=4, max_new=4, arrival=0.0, priority=1,
+         deadline=None):
+    return Request(rid=rid, prompt=list(range(prompt_len)),
+                   max_new_tokens=max_new, arrival_s=arrival,
+                   priority=priority, deadline_s=deadline)
+
+
+def test_admission_permanent_vs_transient():
+    """Permanent sheds are decided by capacity, not occupancy: a prompt
+    over the prefill window or over the two-tier page capacity can NEVER
+    be served, while a merely-busy fleet queues."""
+    adm = AdmissionControl(seq=8, max_context=16,
+                           overhead_tokens=2,
+                           pages_needed=lambda toks: -(-toks // 4),
+                           capacity_pages=lambda: 4)
+    assert adm.permanent_shed_reason(_req(0, prompt_len=9)) == \
+        "prompt_too_long"
+    assert adm.permanent_shed_reason(_req(1, prompt_len=8, max_new=9)) == \
+        "over_max_context"
+    # 8 prompt + 6 new + 2 overhead = 16 tokens -> 4 pages == capacity: ok
+    assert adm.permanent_shed_reason(_req(2, prompt_len=8, max_new=6)) \
+        is None
+    # one token more blows the BOTH-tiers capacity -> permanent
+    assert adm.permanent_shed_reason(_req(3, prompt_len=8, max_new=7)) == \
+        "prompt_too_long"
+
+
+def test_admission_queue_displacement():
+    """Queue-cap shed-or-queue: a more urgent arrival displaces the
+    lowest-priority waiter; a less urgent one is itself the victim; and
+    with no cap everything queues."""
+    adm = AdmissionControl(seq=8, queue_cap=2)
+    waiting = []
+    assert adm.queue_or_displace(_req(0, priority=1), waiting) is None
+    assert adm.queue_or_displace(_req(1, priority=2), waiting) is None
+    # full queue, urgent arrival: the priority-2 waiter is displaced
+    victim = adm.queue_or_displace(_req(2, priority=0), waiting)
+    assert victim is not None and victim.rid == 1
+    assert [r.rid for r in waiting] == [0, 2]
+    # full queue, batch arrival: the arrival itself is the victim
+    late = _req(3, priority=3)
+    assert adm.queue_or_displace(late, waiting) is late
+    assert [r.rid for r in waiting] == [0, 2]
+    uncapped = AdmissionControl(seq=8)
+    w2 = []
+    for i in range(5):
+        assert uncapped.queue_or_displace(_req(i), w2) is None
+    assert len(w2) == 5
+
+
+def test_admission_stale_sweep():
+    """The deadline/TTFT-budget sweep removes exactly the waiters that can
+    no longer make it: elapsed wait + the EMA prefill estimate vs the
+    budget, and hard per-request deadlines."""
+    adm = AdmissionControl(seq=8, ttft_budget_ms=100.0)
+    fresh = _req(0, arrival=0.95)
+    doomed = _req(1, arrival=0.80)          # waited 200ms > 100ms budget
+    dead = _req(2, arrival=0.0, deadline=0.5)
+    waiting = [fresh, doomed, dead]
+    out = adm.stale(waiting, now_s=1.0, ema_serve_ms=30.0)
+    assert sorted((r.rid, why) for r, why in out) == \
+        [(1, "ttft_budget"), (2, "deadline")]
+    assert waiting == [fresh]
+
+
+# ------------------------------------------------------------------ router
+class _FakeSched:
+    def __init__(self, queue_depth=0, ema_ms=50.0, done=0):
+        self.queue_depth = queue_depth
+        self._ema_serve_ms = ema_ms
+        self.completed = [None] * done
+        self.shed = []
+        self.failed = []
+        self.handoffs = 0
+
+
+class _FakeSLO:
+    def __init__(self, burn):
+        self.objectives = {"ttft_p99_ms": {}}
+        self._burn = burn
+
+    def report(self):
+        return {"worst_burn_rate": self._burn}
+
+
+class _FakeEngine:
+    def __init__(self, burn=None, watching=True, swap_ok=True, version=0):
+        if burn is not None:
+            self.slo = _FakeSLO(burn)
+        self.watching = watching
+        self._swap_ok = swap_ok
+        self.active_version = version
+        self.rolled_back = False
+
+    def poll_swap(self, force=False):
+        if self._swap_ok:
+            self.active_version += 1
+            return True
+        return False
+
+    def rollback(self):
+        self.rolled_back = True
+        self.active_version -= 1
+
+
+def _handle(idx, assigned=0, done=0, depth=0, ema_ms=50.0, burn=None):
+    h = ReplicaHandle(idx, _FakeEngine(burn=burn))
+    h.sched = _FakeSched(queue_depth=depth, ema_ms=ema_ms, done=done)
+    h.assigned = assigned
+    return h
+
+
+def test_router_least_loaded_picks_min_outstanding():
+    # replica 0 has 3 outstanding, replica 1 has 1 -> pick 1
+    a = _handle(0, assigned=5, done=2)
+    b = _handle(1, assigned=3, done=2)
+    assert FleetRouter().pick([a, b]) is b
+    # tie on outstanding -> estimated TTFT (queue depth x EMA) breaks it
+    c = _handle(2, assigned=3, done=2, depth=4, ema_ms=100.0)
+    d = _handle(3, assigned=3, done=2, depth=1, ema_ms=100.0)
+    assert FleetRouter().pick([c, d]) is d
+    # and the estimator is the same quantity the TTFT-budget shed prices
+    assert FleetRouter().estimated_ttft_s(d) == pytest.approx(0.2)
+
+
+def test_router_burn_ceiling_steers_away():
+    """A replica whose SLO worst burn crossed the ceiling only receives
+    work when EVERY alternative crossed too (never starves the fleet)."""
+    hot = _handle(0, assigned=0, burn=3.0)      # idle but burning
+    busy = _handle(1, assigned=4, burn=0.1)
+    r = FleetRouter(burn_max=1.0)
+    assert r.pick([hot, busy]) is busy
+    # without the ceiling the idle replica wins on load
+    assert FleetRouter().pick([hot, busy]) is hot
+    # everyone burning -> load order again (no starvation)
+    both = [_handle(0, assigned=9, burn=3.0), _handle(1, assigned=1,
+                                                      burn=2.0)]
+    assert r.pick(both) is both[1]
+
+
+def test_router_round_robin_and_validation():
+    h = [_handle(i) for i in range(3)]
+    r = FleetRouter("round_robin")
+    assert [r.pick(h).index for _ in range(5)] == [0, 1, 2, 0, 1]
+    with pytest.raises(ValueError):
+        FleetRouter("random")
+    with pytest.raises(ValueError):
+        FleetRouter().pick([])
+
+
+# ------------------------------------------------------------ rolling swap
+def test_rolling_swap_cursor_gates_one_at_a_time():
+    """Replica k may only take the new version after replicas 0..k-1 did
+    — the rollout advances one replica per safe point, in order."""
+    engines = [_FakeEngine() for _ in range(3)]
+    ctl = RollingSwapController(engines)
+    # replica 1 and 2 hit their safe points first: refused (cursor at 0)
+    assert ctl.at_safe_point(1) is False
+    assert ctl.at_safe_point(2) is False
+    assert ctl.at_safe_point(0) is True
+    # replica 0 took it; a SECOND snapshot must wait for the ring to close
+    assert ctl.at_safe_point(0) is False
+    # NOW replica 1 may advance; 2 still gated behind it
+    assert ctl.at_safe_point(2) is False
+    assert ctl.at_safe_point(1) is True
+    assert ctl.at_safe_point(2) is True
+    assert [r for r, _ in ctl.swaps] == [0, 1, 2]
+    # ring closed: replica 0 is eligible again (the next rollout)
+    assert ctl.at_safe_point(0) is True
+    assert not ctl.halted and not ctl.rollbacks
+
+
+def test_rolling_swap_skips_non_watching_and_empty_poll():
+    engines = [_FakeEngine(watching=False), _FakeEngine(swap_ok=False)]
+    ctl = RollingSwapController(engines)
+    assert ctl.at_safe_point(0) is False      # not watching
+    ctl2 = RollingSwapController([engines[1]])
+    assert ctl2.at_safe_point(0) is False     # watching, nothing staged
+    assert not ctl.swaps and not ctl2.swaps
+
+
+def test_rolling_swap_rollback_on_burn_freezes_rollout():
+    """A swapped replica that starts burning its SLO budget past the
+    ceiling is rolled back to the pinned version and the rollout HALTS —
+    a bad model stops at one replica instead of deploying fleet-wide."""
+    engines = [_FakeEngine(burn=0.0), _FakeEngine(burn=0.0)]
+    ctl = RollingSwapController(engines, burn_max=1.0)
+    assert ctl.at_safe_point(0) is True
+    assert engines[0].active_version == 1
+    # bake period: replica 0's SLO goes bad before replica 1 advances
+    engines[0].slo._burn = 5.0
+    assert ctl.at_safe_point(0) is True       # params changed: rollback
+    assert engines[0].rolled_back and engines[0].active_version == 0
+    assert ctl.halted is True
+    assert ctl.rollbacks == [(0, 0)]
+    # frozen: replica 1 never takes the bad version
+    assert ctl.at_safe_point(1) is False
+    assert engines[1].active_version == 0
+    # a rolled-back replica is not rolled back twice
+    assert ctl.at_safe_point(0) is False
+
+
+def test_rolling_swap_no_burn_objectives_never_rolls_back():
+    engines = [_FakeEngine()]                 # no slo attribute at all
+    ctl = RollingSwapController(engines, burn_max=1.0)
+    assert ctl.at_safe_point(0) is True
+    assert ctl.at_safe_point(0) is True       # keeps swapping, no rollback
+    assert not ctl.rollbacks and not ctl.halted
+
+
+# ------------------------------------------------- prefetch-ahead autotune
+def test_derive_prefetch_ahead_pinned_math():
+    """The autotuned rotation lead is ceil(learned kv_transfer seconds /
+    measured decode-step seconds), clamped to [1, 64]; the flag value is
+    the FALLBACK when either side of the ratio is unavailable."""
+    assert derive_prefetch_ahead(0.01, 0.002, 4) == 5     # ceil(5.0)
+    assert derive_prefetch_ahead(0.0101, 0.002, 4) == 6   # ceil(5.05)
+    assert derive_prefetch_ahead(0.0001, 0.1, 4) == 1     # floor clamp
+    assert derive_prefetch_ahead(10.0, 0.001, 4) == 64    # ceiling clamp
+    assert derive_prefetch_ahead(None, 0.002, 4) == 4     # no learned model
+    assert derive_prefetch_ahead(0.01, None, 7) == 7      # no step sample
+    assert derive_prefetch_ahead(0.01, 0.0, 3) == 3       # degenerate step
+
+
+def test_scheduler_autotune_closes_loop_once():
+    """First measured decode step re-derives the lead from the learned
+    kv_transfer coefficient; later (noisier) steps leave it alone."""
+    from flexflow_tpu.serving.scheduler import ContinuousBatchingScheduler
+    s = ContinuousBatchingScheduler.__new__(ContinuousBatchingScheduler)
+    s._autotune_transfer_s = 0.01
+    s._autotuned = False
+    s.prefetch_ahead = 4
+    s._maybe_autotune(0.002)
+    assert s.prefetch_ahead == 5
+    s._maybe_autotune(0.0001)                 # second sample: ignored
+    assert s.prefetch_ahead == 5
+    # no learned model resolved -> the flag value stays authoritative
+    s2 = ContinuousBatchingScheduler.__new__(ContinuousBatchingScheduler)
+    s2._autotune_transfer_s = None
+    s2._autotuned = False
+    s2.prefetch_ahead = 4
+    s2._maybe_autotune(0.002)
+    assert s2.prefetch_ahead == 4
+
+
+# ------------------------------------------------------------- bench smoke
+@pytest.mark.slow  # ~18s: two engines + five serve legs (identity,
+# scaling, mixed priorities, disagg handoff, rolling swap)
+def test_bench_fleet_check_smoke(devices, capsys):
+    """tools/bench_fleet.py --check end to end on the CPU twin: bitwise
+    single-replica identity vs the pre-fleet scheduler, 2-replica weak
+    scaling, mixed-priority TTFT ordering, disagg prefill->decode handoff
+    parity, and a zero-drop rolling swap."""
+    import bench_fleet
+    assert bench_fleet.main(["--check"]) == 0
+    assert "CHECK PASS" in capsys.readouterr().out

@@ -237,6 +237,12 @@ class _AdmitProbe(ContinuousBatchingScheduler):
         self.shed = []
         self.stats = {"shed_prompt_too_long": 0, "shed_over_max_context": 0,
                       "shed_queue_full": 0}
+        # the decisions now live in the fleet-shared policy brain
+        from flexflow_tpu.serving.fleet import AdmissionControl
+        self.admission = AdmissionControl(
+            seq=seq, max_context=max_context, queue_cap=self.queue_cap,
+            overhead_tokens=self.dispatch_ahead + self.spec_tokens,
+            pages_needed=kv.pages_needed, capacity_pages=kv.capacity_pages)
 
 
 def test_admission_sheds_permanent_keeps_transient():

@@ -129,6 +129,14 @@ FAMILIES: Dict[str, Tuple[str, Callable[[Dict[str, Any]],
                    ("context_gain_vs_hbm_only", "prefetch_hit_rate",
                     "spill_parity", "ring_crossover", "legs_passed")
                    if d.get(k) is not None]),
+    "fleet": (
+        r"^BENCH_fleet\.json$",
+        lambda d: [(k, float(d[k])) for k in
+                   ("scale2_x", "scale4_x", "fleet_tokens_per_s",
+                    "mixed_ttft_p99_s", "rolling_swaps",
+                    "rolling_dropped_inflight", "disagg_goodput_ratio",
+                    "legs_passed")
+                   if d.get(k) is not None]),
     "slo": (
         r"^BENCH_reqtrace\.json$",
         lambda d: [(k, float(d[k])) for k in

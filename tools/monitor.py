@@ -75,7 +75,12 @@ def gather(events: List[Dict[str, Any]]) -> Dict[str, Any]:
              # ISSUE 16: tiered KV cache counters (latest sample wins —
              # the scheduler re-emits at every rotation sync point)
              "kv_hot_pages": None, "kv_cold_pages": None,
-             "kv_prefetch_hits": 0, "kv_prefetch_stalls": 0, "kv_spills": 0}
+             "kv_prefetch_hits": 0, "kv_prefetch_stalls": 0, "kv_spills": 0,
+             # ISSUE 18: disaggregated fleet — per-replica scoreboard rows
+             # (latest serve/fleet_replica per index wins), the fleet-wide
+             # summary, and the rolling-rollout action counters
+             "fleet_replicas": {}, "fleet": None,
+             "fleet_rollout_swaps": 0, "fleet_rollout_rollbacks": 0}
     for ev in events:
         name = ev.get("name", "")
         args = ev.get("args") or {}
@@ -135,6 +140,15 @@ def gather(events: List[Dict[str, Any]]) -> Dict[str, Any]:
             serve["kv_prefetch_stalls"] = int(args.get("value") or 0)
         elif name == "serve/kv_spills":
             serve["kv_spills"] = int(args.get("value") or 0)
+        elif name == "serve/fleet_replica":
+            serve["fleet_replicas"][int(args.get("replica") or 0)] = args
+        elif name == "serve/fleet":
+            serve["fleet"] = args
+        elif name == "serve/fleet_rollout":
+            if args.get("action") == "rollback":
+                serve["fleet_rollout_rollbacks"] += 1
+            else:
+                serve["fleet_rollout_swaps"] += 1
         elif name == "serve/hist":
             serve["hist_snaps"].append(args)
         elif name == "serve/slo":
@@ -276,6 +290,10 @@ def _serve_stats(serve: Dict[str, Any]) -> Optional[Dict[str, Any]]:
                + serve.get("kv_prefetch_stalls", 0))
             if (serve.get("kv_prefetch_hits", 0)
                 + serve.get("kv_prefetch_stalls", 0)) else None),
+        "fleet": serve.get("fleet"),
+        "fleet_replicas": serve.get("fleet_replicas") or {},
+        "fleet_rollout_swaps": serve.get("fleet_rollout_swaps", 0),
+        "fleet_rollout_rollbacks": serve.get("fleet_rollout_rollbacks", 0),
     }
 
 
@@ -369,6 +387,31 @@ def render(state: Dict[str, Any]) -> List[str]:
                 f"         requests={slo.get('requests', 0)} "
                 f"shed_rate={100.0 * float(slo.get('shed_rate', 0.0)):.1f}% "
                 f"worst_burn={float(slo.get('worst_burn_rate', 0.0)):.2f}x")
+        fl = sv.get("fleet")
+        reps = sv.get("fleet_replicas") or {}
+        if fl or reps:
+            # ISSUE 18: disaggregated fleet — one summary line + one line
+            # per replica (role, throughput, live occupancy, live version)
+            if fl:
+                lines.append(
+                    f"fleet    {fl.get('replicas', len(reps))} replicas "
+                    f"({fl.get('topology', '?')})  "
+                    f"{float(fl.get('tokens_per_s', 0.0)):.1f} tok/s  "
+                    f"done={fl.get('completed', 0)} "
+                    f"shed={fl.get('shed', 0)} "
+                    f"handoffs={fl.get('handoffs', 0)}  "
+                    f"rollout swaps={sv['fleet_rollout_swaps']} "
+                    f"rollbacks={sv['fleet_rollout_rollbacks']}")
+            for idx in sorted(reps):
+                r = reps[idx]
+                lines.append(
+                    f"         r{idx} [{r.get('role', '?'):>8}] "
+                    f"{float(r.get('tokens_per_s', 0.0)):6.1f} tok/s  "
+                    f"done={r.get('completed', 0)} "
+                    f"assigned={r.get('assigned', 0)} "
+                    f"slots={r.get('active_slots', 0)} "
+                    f"queue={r.get('queue_depth', 0)} "
+                    f"v{r.get('swap_version') if r.get('swap_version') is not None else '-'}")
     sent = state["sentinels"]
     bad = sent["nonfinite"] or state["halts"]
     status = "FATAL" if bad else (
@@ -550,6 +593,53 @@ def prom_export(state: Dict[str, Any], path: str) -> None:
             gauge("flexflow_serve_slo_worst_burn_rate",
                   float(slo.get("worst_burn_rate", 0.0)),
                   "Max burn rate across objectives and windows")
+        fl = sv.get("fleet")
+        reps = sv.get("fleet_replicas") or {}
+        if fl or reps:
+            # ISSUE 18: disaggregated fleet — per-replica series carry the
+            # replica index (and role) as labels so one scrape covers the
+            # whole fleet
+            if fl:
+                gauge("flexflow_fleet_replicas",
+                      float(fl.get("replicas", len(reps))),
+                      "Serving replicas in the fleet")
+                gauge("flexflow_fleet_tokens_per_second",
+                      float(fl.get("tokens_per_s", 0.0)),
+                      "Aggregate fleet serving throughput")
+                gauge("flexflow_fleet_handoffs_total",
+                      float(fl.get("handoffs", 0)),
+                      "Prefill->decode KV handoffs across the fleet")
+            gauge("flexflow_fleet_rollout_swaps_total",
+                  float(sv["fleet_rollout_swaps"]),
+                  "Rolling-rollout replica swaps completed")
+            gauge("flexflow_fleet_rollout_rollbacks_total",
+                  float(sv["fleet_rollout_rollbacks"]),
+                  "Rolling-rollout rollbacks (SLO burn during bake)")
+            _FLEET_SERIES = [
+                ("flexflow_fleet_replica_tokens_per_second", "tokens_per_s",
+                 "Per-replica serving throughput"),
+                ("flexflow_fleet_replica_completed_total", "completed",
+                 "Per-replica completed requests"),
+                ("flexflow_fleet_replica_assigned_total", "assigned",
+                 "Per-replica requests routed by the fleet router"),
+                ("flexflow_fleet_replica_active_slots", "active_slots",
+                 "Per-replica occupied decode slots (last sample)"),
+                ("flexflow_fleet_replica_queue_depth", "queue_depth",
+                 "Per-replica waiting queue depth (last sample)"),
+                ("flexflow_fleet_replica_swap_version", "swap_version",
+                 "Per-replica live parameter version"),
+            ]
+            for name, key, help_ in _FLEET_SERIES:
+                rows = [(idx, reps[idx]) for idx in sorted(reps)
+                        if reps[idx].get(key) is not None]
+                if not rows:
+                    continue
+                g.append(f"# HELP {name} {help_}")
+                g.append(f"# TYPE {name} gauge")
+                for idx, r in rows:
+                    g.append('%s{replica="%d",role="%s"} %g'
+                             % (name, idx, r.get("role", "?"),
+                                float(r[key])))
     tmp = path + ".tmp"
     with open(tmp, "w") as f:
         f.write("\n".join(g) + "\n")
