@@ -320,6 +320,20 @@ class FFConfig:
     serve_prefill_replicas: int = 1
     serve_router: str = "least_loaded"
     serve_rollout_burn_max: float = 0.0
+    # capacity twin (ISSUE 20): replayable traces + offline what-if replay.
+    #   serve_trace_out — export the offered load (arrival_ts, tokens_in,
+    #                     max_tokens, priority, deadline, prompt) as a
+    #                     versioned tracefmt JSONL at serve end; "" = off.
+    #                     A recorded trace replays through tools/twin.py
+    #                     (offline capacity questions) or a live engine.
+    #   twin_trace      — trace file the twin CLI replays.
+    #   twin_replicas   — replica count the twin simulates (0 = follow
+    #                     --serve-replicas).
+    #   twin_out        — write the twin report JSON here ("" = stdout).
+    serve_trace_out: str = ""
+    twin_trace: str = ""
+    twin_replicas: int = 0
+    twin_out: str = ""
 
     REMAT_POLICY_NAMES = ("none", "dots", "full")
 
@@ -485,6 +499,16 @@ class FFConfig:
         p.add_argument("--serve-router", type=str, default="least_loaded",
                        choices=("least_loaded", "round_robin"))
         p.add_argument("--serve-rollout-burn-max", type=float, default=0.0)
+        p.add_argument("--serve-trace-out", type=str, default="",
+                       help="export the served load as a replayable "
+                            "tracefmt JSONL trace at serve end")
+        p.add_argument("--twin-trace", type=str, default="",
+                       help="trace file the capacity twin replays")
+        p.add_argument("--twin-replicas", type=int, default=0,
+                       help="replica count the twin simulates "
+                            "(0 = --serve-replicas)")
+        p.add_argument("--twin-out", type=str, default="",
+                       help="twin report JSON path ('' = stdout)")
         return p
 
     @staticmethod
@@ -609,4 +633,8 @@ class FFConfig:
             serve_prefill_replicas=args.serve_prefill_replicas,
             serve_router=args.serve_router,
             serve_rollout_burn_max=args.serve_rollout_burn_max,
+            serve_trace_out=args.serve_trace_out,
+            twin_trace=args.twin_trace,
+            twin_replicas=args.twin_replicas,
+            twin_out=args.twin_out,
         )

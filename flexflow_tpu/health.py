@@ -674,6 +674,126 @@ class SLOTracker:
         }
 
 
+def merge_slo_trackers(trackers) -> "SLOTracker":
+    """Rebuild the SLO scoreboard a single tracker would hold had it
+    observed the union of every replica's terminal records: totals and
+    outcome tallies add, events interleave by timestamp (the window walk
+    needs time order), objectives union across the pool, and — the
+    windowed-state fix — the merged event ring inherits the base
+    tracker's bound, so window burn rates match a union-fed tracker
+    exactly even when the ring has wrapped (pinned in tests). Lives in
+    health.py next to SLOTracker; serving/fleet re-exports it."""
+    trackers = [t for t in trackers if t is not None]
+    if not trackers:
+        return SLOTracker({})
+    base = trackers[0]
+    objectives: Dict[str, Dict[str, Any]] = {}
+    for t in trackers:
+        objectives.update(t.objectives)
+    out = SLOTracker(objectives, windows_s=base.windows_s,
+                     max_events=base.events.maxlen)
+    events: List[Tuple[float, Dict[str, bool]]] = []
+    for t in trackers:
+        events.extend(t.events)
+        for name, (total, bad) in t.totals.items():
+            slot = out.totals.setdefault(name, [0, 0])
+            slot[0] += total
+            slot[1] += bad
+        out.requests += t.requests
+        for oc, n in t.outcomes.items():
+            out.outcomes[oc] = out.outcomes.get(oc, 0) + n
+    events.sort(key=lambda e: e[0])
+    out.events.extend(events)
+    return out
+
+
+# ------------------------------------------------- scaling recommendation
+# Multi-window burn-rate policy thresholds (the textbook SRE shape: a
+# fast-window burn this hot, CONFIRMED by the slow window, exhausts the
+# budget long before a human reacts — recommend scale-out while
+# budget_remaining is still positive).
+SCALE_OUT_FAST_BURN = 6.0    # short-window burn that demands action
+SCALE_OUT_SLOW_BURN = 1.0    # long-window burn confirming it's not a blip
+SCALE_IN_MAX_BURN = 0.5      # every window this cool -> capacity to spare
+SCALE_IN_MIN_BUDGET = 0.9    # ... and nearly all budget intact
+
+
+def scaling_signal(slo_report: Dict[str, Any],
+                   fast_burn: float = SCALE_OUT_FAST_BURN,
+                   slow_burn: float = SCALE_OUT_SLOW_BURN
+                   ) -> Dict[str, Any]:
+    """Turn one SLOTracker.report() into a scaling recommendation —
+    the policy half of ROADMAP item 5's autoscaler, shared by
+    `health_report()`, the fleet report, the twin's burst replay, and
+    the monitor panel. Actions:
+
+      scale_out      — some objective's short-window burn >= fast_burn
+                       with the long window confirming (>= slow_burn);
+                       fired BEFORE budget_remaining exhausts.
+      objective_flip — an error budget already exhausted
+                       (budget_remaining <= 0): added capacity can't
+                       un-burn history; flip the latency<->throughput
+                       objective (or re-tier admission) instead.
+      scale_in       — every objective cold (all window burns <=
+                       SCALE_IN_MAX_BURN, budgets >= SCALE_IN_MIN_BUDGET).
+      steady         — anything else.
+
+    Returns {"action", "objective", "reason", "budget_remaining",
+    "worst_burn_rate"} — `objective` names the offender (or None)."""
+    objectives = slo_report.get("objectives") or {}
+    windows = sorted(float(w) for w in (slo_report.get("windows_s") or
+                                        SLOTracker.WINDOWS_S))
+    if not objectives:
+        return {"action": "steady", "objective": None,
+                "reason": "no SLO objectives configured",
+                "budget_remaining": None, "worst_burn_rate": None}
+    w_fast, w_slow = windows[0], windows[-1]
+    min_budget, min_budget_obj = None, None
+    flip_obj = None
+    out_obj, out_reason = None, None
+    all_cold = True
+    for name, entry in objectives.items():
+        budget = entry.get("budget_remaining")
+        if budget is not None and (min_budget is None or
+                                   budget < min_budget):
+            min_budget, min_budget_obj = budget, name
+        bf = entry.get(f"burn_rate_{w_fast:g}s")
+        bs = entry.get(f"burn_rate_{w_slow:g}s")
+        if budget is not None and budget <= 0.0 and flip_obj is None:
+            flip_obj = name
+        confirmed = bs is None or bs >= slow_burn
+        if bf is not None and bf >= fast_burn and confirmed \
+                and out_obj is None:
+            out_obj = name
+            out_reason = (f"burn_rate_{w_fast:g}s={bf:.2f} >= "
+                          f"{fast_burn:g} (slow window "
+                          f"{'confirms' if bs is not None else 'empty'})")
+        for b in (bf, bs):
+            if b is not None and b > SCALE_IN_MAX_BURN:
+                all_cold = False
+        if budget is not None and budget < SCALE_IN_MIN_BUDGET:
+            all_cold = False
+    worst = slo_report.get("worst_burn_rate")
+    if flip_obj is not None:
+        return {"action": "objective_flip", "objective": flip_obj,
+                "reason": (f"{flip_obj} error budget exhausted "
+                           "(budget_remaining <= 0): capacity alone "
+                           "cannot un-burn history"),
+                "budget_remaining": min_budget, "worst_burn_rate": worst}
+    if out_obj is not None:
+        return {"action": "scale_out", "objective": out_obj,
+                "reason": out_reason,
+                "budget_remaining": min_budget, "worst_burn_rate": worst}
+    if all_cold:
+        return {"action": "scale_in", "objective": min_budget_obj,
+                "reason": (f"all window burns <= {SCALE_IN_MAX_BURN:g} "
+                           f"and budgets >= {SCALE_IN_MIN_BUDGET:g}"),
+                "budget_remaining": min_budget, "worst_burn_rate": worst}
+    return {"action": "steady", "objective": min_budget_obj,
+            "reason": "burn within budgeted pace",
+            "budget_remaining": min_budget, "worst_burn_rate": worst}
+
+
 def format_kv_tier(tier_stats: Dict[str, Any]) -> Dict[str, Any]:
     """Normalize a PagedKVCache.tier_stats() snapshot into the health
     report's serving section: occupancy, transfer totals, and the derived

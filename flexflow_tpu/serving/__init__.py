@@ -6,6 +6,7 @@ strategy, and a continuous-batching scheduler driving a device-resident
 decode loop. Entry point: `compile_serving(model)`.
 """
 
+from flexflow_tpu.serving import tracefmt
 from flexflow_tpu.serving.engine import ServingCompiled, compile_serving
 from flexflow_tpu.serving.fleet import (AdmissionControl, FleetRouter,
                                         RollingSwapController, ServingFleet,
@@ -20,6 +21,10 @@ from flexflow_tpu.serving.reqtrace import (RequestTracer, StreamingHistogram,
 from flexflow_tpu.serving.scheduler import (ContinuousBatchingScheduler,
                                             Request, gpt2_prompt_inputs,
                                             gpt2_step_inputs)
+from flexflow_tpu.serving.tracefmt import (Trace, TraceRecord, load_trace,
+                                           save_trace)
+from flexflow_tpu.serving.twin import (TwinCosts, TwinResult, TwinSpec,
+                                       capacity_curve, simulate)
 
 __all__ = [
     "compile_serving", "ServingCompiled", "PagedKVCache", "KVPoolExhausted",
@@ -31,4 +36,6 @@ __all__ = [
     "ServingFleet", "AdmissionControl", "FleetRouter",
     "RollingSwapController", "merge_histograms", "merge_slo_trackers",
     "derive_prefetch_ahead",
+    "tracefmt", "Trace", "TraceRecord", "load_trace", "save_trace",
+    "TwinSpec", "TwinCosts", "TwinResult", "simulate", "capacity_curve",
 ]

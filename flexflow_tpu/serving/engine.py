@@ -743,6 +743,9 @@ class ServingCompiled:
         remaining + windowed burn rates per objective, ISSUE 15)."""
         serving = self.swap_stats.report()
         serving["slo"] = self.slo.report()
+        # ROADMAP item 5: the multi-window burn policy's recommendation
+        # (scale_out/scale_in/objective_flip/steady) rides the report
+        serving["scaling"] = health.scaling_signal(serving["slo"])
         if self.kv.host_pages:
             serving["kv_tier"] = health.format_kv_tier(self.kv.tier_stats())
         return {"watermarks":

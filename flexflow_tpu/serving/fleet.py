@@ -46,7 +46,8 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import jax
 
 from flexflow_tpu import telemetry as tel
-from flexflow_tpu.health import SLOTracker, parse_slo
+from flexflow_tpu.health import (SLOTracker, merge_slo_trackers,  # noqa: F401
+                                 parse_slo, scaling_signal)
 from flexflow_tpu.serving.reqtrace import (HIST_METRICS, StreamingHistogram,
                                            terminal_record)
 from flexflow_tpu.serving.scheduler import (ContinuousBatchingScheduler,
@@ -147,30 +148,10 @@ def merge_histograms(hists) -> StreamingHistogram:
     return out
 
 
-def merge_slo_trackers(trackers) -> SLOTracker:
-    """Rebuild the SLO scoreboard a single tracker would hold had it
-    observed the union of every replica's terminal records: totals and
-    outcome tallies add, events interleave by timestamp (the window walk
-    needs time order). Burn rates/budgets of the merged tracker match a
-    union-fed one exactly (pinned in tests)."""
-    trackers = [t for t in trackers if t is not None]
-    if not trackers:
-        return SLOTracker({})
-    base = trackers[0]
-    out = SLOTracker(dict(base.objectives), windows_s=base.windows_s)
-    events: List[Tuple[float, Dict[str, bool]]] = []
-    for t in trackers:
-        events.extend(t.events)
-        for name, (total, bad) in t.totals.items():
-            slot = out.totals.setdefault(name, [0, 0])
-            slot[0] += total
-            slot[1] += bad
-        out.requests += t.requests
-        for oc, n in t.outcomes.items():
-            out.outcomes[oc] = out.outcomes.get(oc, 0) + n
-    events.sort(key=lambda e: e[0])
-    out.events.extend(events)
-    return out
+# merge_slo_trackers moved to health.py (next to SLOTracker — the
+# windowed-state-preserving merge is an SLO concern, not a fleet one);
+# re-exported here so `from serving.fleet import merge_slo_trackers`
+# keeps working.
 
 
 # ------------------------------------------------------------------ feed
@@ -527,6 +508,10 @@ class ServingFleet:
             capacity_pages=eng0.kv.capacity_pages)
         self.slo = SLOTracker(parse_slo(getattr(cfg, "serve_slo", "")
                                         or ""))
+        # --serve-trace-out (ISSUE 20): the fleet exports ONE pool-wide
+        # replayable trace of the offered load; replica schedulers have
+        # their per-replica export cleared in _build_sched.
+        self.trace_out = str(getattr(cfg, "serve_trace_out", "") or "")
         self.rolling: Optional[RollingSwapController] = None
         self.completed: List[Request] = []
         self.shed: List[Request] = []
@@ -545,6 +530,9 @@ class ServingFleet:
             eng, eng.params, self.prompt_inputs_fn,
             self.step_inputs_fn, eos_id=self.eos_id, handoff=handoff,
             **self.sched_kwargs)
+        if len(self.replicas) > 1:
+            # one trace for the pool (serve() exports it), not N partials
+            sched.trace_out = ""
         h.sched = sched
         return sched
 
@@ -652,6 +640,14 @@ class ServingFleet:
             for h in self.replicas:
                 h.thread.join()
         self._collect()
+        if self.trace_out and requests:
+            from flexflow_tpu.serving import tracefmt
+            tracefmt.save_trace(
+                self.trace_out,
+                tracefmt.requests_to_records(
+                    sorted(requests, key=lambda r: (r.arrival_s, r.rid))),
+                meta={"source": "fleet", "replicas": len(self.replicas),
+                      "topology": self.topology})
         return list(self.completed)
 
     # ------------------------------------------------------------- results
@@ -719,5 +715,10 @@ class ServingFleet:
                             "p99": merged.quantile(0.99)}
         trackers = [getattr(h.engine, "slo", None) for h in self.replicas]
         merged_slo = merge_slo_trackers(trackers + [self.slo])
+        slo_report = merged_slo.report()
         return {"stats": dict(self.stats), "hists": hists,
-                "slo": merged_slo.report()}
+                "slo": slo_report,
+                # ROADMAP item 5: the burn-rate policy's recommendation
+                # rides every fleet report (the router-driven autoscaler's
+                # input signal)
+                "scaling": scaling_signal(slo_report)}

@@ -271,6 +271,12 @@ class ContinuousBatchingScheduler:
         # SLO classification rides the unified terminal records (cheap
         # host arithmetic, no syncs) so it stays on even without tracing
         self.slo = getattr(engine, "slo", None)
+        # --serve-trace-out (ISSUE 20): export the offered load as a
+        # replayable tracefmt trace at run() end — recorded traffic and
+        # synthetic bench load become interchangeable twin inputs. A
+        # fleet clears this per replica and exports ONE pool-wide trace.
+        self.trace_out = str(getattr(cfg, "serve_trace_out", "") or "")
+        self._trace_arrivals: List[Request] = []
         self._t0 = time.perf_counter()  # run() re-anchors
 
     # ----------------------------------------------------------- terminal
@@ -320,6 +326,10 @@ class ContinuousBatchingScheduler:
         replica that owns the request."""
         if self.tracer is not None:
             self.tracer.on_submit(req, now_s)
+        # getattr: admission-probe test doubles duck-type the scheduler
+        # without running __init__
+        if getattr(self, "trace_out", ""):
+            self._trace_arrivals.append(req)
         reason = self.admission.permanent_shed_reason(req)
         if reason is not None:
             self._shed(req, reason, now_s)
@@ -1042,4 +1052,13 @@ class ContinuousBatchingScheduler:
                                          if self._ema_serve_ms else None))
             except Exception:  # noqa: BLE001 — never fail a served batch
                 pass
+        if getattr(self, "trace_out", "") and self._trace_arrivals:
+            from flexflow_tpu.serving import tracefmt
+            tracefmt.save_trace(
+                self.trace_out,
+                tracefmt.requests_to_records(
+                    sorted(self._trace_arrivals,
+                           key=lambda r: (r.arrival_s, r.rid))),
+                meta={"source": "scheduler", "slots": self.slots,
+                      "seq": self.seq})
         return self.completed

@@ -301,6 +301,33 @@ def test_slo_reqtrace_flags_wired():
     assert "--serve-reqtrace" not in vf
 
 
+def test_twin_trace_flags_wired():
+    """The ISSUE-20 capacity-twin knobs flow parse_args -> FFConfig via
+    build_parser only: live trace export (--serve-trace-out) and the
+    twin CLI's replay inputs (--twin-trace/--twin-replicas/--twin-out).
+    All default off — recording and replay are strictly opt-in."""
+    from flexflow_tpu.config import FFConfig as Cfg
+
+    cfg = Cfg.parse_args(["--serve-trace-out", "/tmp/live.jsonl",
+                          "--twin-trace", "/tmp/replay.jsonl",
+                          "--twin-replicas", "4",
+                          "--twin-out", "/tmp/twin.json"])
+    assert cfg.serve_trace_out == "/tmp/live.jsonl"
+    assert cfg.twin_trace == "/tmp/replay.jsonl"
+    assert cfg.twin_replicas == 4
+    assert cfg.twin_out == "/tmp/twin.json"
+    d = Cfg()
+    assert d.serve_trace_out == ""   # no export unless asked
+    assert d.twin_trace == ""
+    assert d.twin_replicas == 0      # 0 = follow --serve-replicas
+    assert d.twin_out == ""          # report to stdout
+    # all four consume value tokens (launcher passthrough safety)
+    vf = Cfg.launcher_value_flags()
+    for flag in ("--serve-trace-out", "--twin-trace",
+                 "--twin-replicas", "--twin-out"):
+        assert flag in vf, flag
+
+
 def test_fleet_flags_wired():
     """The ISSUE-18 fleet knobs flow parse_args -> FFConfig via
     build_parser only: replica count, colocated/disagg topology split,

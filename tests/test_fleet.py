@@ -93,6 +93,45 @@ def test_merged_slo_matches_union_fed_tracker():
     assert ts_seq == sorted(ts_seq)
 
 
+def test_merged_slo_preserves_windowed_state_across_wrapped_rings():
+    """The ISSUE 20 windowed-state fix: merge_slo_trackers must carry
+    the event ring's BOUND through the merge (not fall back to the
+    100k default) and keep window burn rates equal to a union-fed
+    tracker's even after the per-replica rings have wrapped. An old bad
+    burst that wrapped OUT of the rings must not haunt burn_rate_60s."""
+    objectives = parse_slo("ttft_p90_ms=100")
+    cap = 6
+    # replica A: an ancient bad burst (t~10s) that its ring then wraps
+    # away under `cap` recent good events; replica B: a recent good tail
+    old_bad = [(10.0 + i, _rec(ttft_s=0.5)) for i in range(4)]
+    recent_a = [(1000.0 + i, _rec(ttft_s=0.01)) for i in range(cap)]
+    recent_b = [(1000.5 + i, _rec(ttft_s=0.02)) for i in range(4)]
+    ta = SLOTracker(dict(objectives), max_events=cap)
+    tb = SLOTracker(dict(objectives), max_events=cap)
+    for ts, rec in old_bad + recent_a:
+        ta.observe(rec, now_s=ts)
+    for ts, rec in recent_b:
+        tb.observe(rec, now_s=ts)
+    assert len(ta.events) == cap  # A's ring really wrapped
+    merged = merge_slo_trackers([ta, tb])
+    assert merged.events.maxlen == cap  # bound inherited, not defaulted
+    # union-fed twin with the same bound, fed the events the rings
+    # actually retained, in time order
+    union = SLOTracker(dict(objectives), max_events=cap)
+    for ts, rec in sorted(recent_a + recent_b)[-cap:]:
+        union.observe(rec, now_s=ts)
+    now = 1006.0
+    mrep = merged.report(now_s=now)
+    urep = union.report(now_s=now)
+    obj = mrep["objectives"]["ttft_p90_ms"]
+    # windowed burn: only the recent (good) tail is in the 60s window
+    assert obj["burn_rate_60s"] == \
+        urep["objectives"]["ttft_p90_ms"]["burn_rate_60s"] == 0.0
+    # cumulative totals still count the wrapped-away burst
+    assert obj["total"] == 14 and obj["bad"] == 4
+    assert merged.requests == 14
+
+
 def test_merge_slo_trackers_empty_pool():
     merged = merge_slo_trackers([None, None])
     assert merged.requests == 0

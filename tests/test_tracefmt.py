@@ -1,0 +1,169 @@
+"""ISSUE 20 — the replayable trace format's versioning contract.
+
+tracefmt is the capacity twin's common tongue: live export, bench
+generators, and the twin loader all speak it, so schema drift here
+silently corrupts every downstream consumer. These tests pin the three
+contract clauses (unknown version rejected, v1 forward-compatible,
+malformed lines skipped + counted), the bitwise save/load round-trip,
+and the legacy-rng pin that makes the refactored benches reproduce the
+pre-tracefmt arrival sequences under a fixed seed.
+"""
+
+import dataclasses
+import json
+
+import numpy as np
+import pytest
+
+from flexflow_tpu.serving import tracefmt
+from flexflow_tpu.serving.tracefmt import (SCHEMA_VERSION, Trace,
+                                           TraceRecord, burst_records,
+                                           load_trace, poisson_records,
+                                           save_trace, scale_rate)
+
+
+def _records(n=5):
+    rng = np.random.default_rng(0)
+    return poisson_records(rng, n, rate=10.0, vocab=64, prompt_len=4,
+                           max_new=8, deadline_s=2.5)
+
+
+# ---------------------------------------------------------- versioning
+def test_unknown_schema_version_rejected(tmp_path):
+    """A twin quietly mispricing a future trace is worse than refusing
+    it: an unknown schema_version must raise, and the error must name
+    both the alien version and the one this build reads."""
+    p = tmp_path / "future.jsonl"
+    p.write_text(json.dumps({"schema_version": SCHEMA_VERSION + 1,
+                             "meta": {}}) + "\n")
+    with pytest.raises(ValueError, match="schema_version"):
+        load_trace(str(p))
+    with pytest.raises(ValueError, match=str(SCHEMA_VERSION)):
+        load_trace(str(p))
+
+
+def test_missing_or_alien_header_rejected(tmp_path):
+    """A file whose first line isn't a JSON header object (a bare
+    records file, a CSV, an empty file) is not a trace."""
+    for body in ("", "not json\n", "[1,2,3]\n",
+                 '{"arrival_ts": 0, "tokens_in": 4, "max_tokens": 2}\n'
+                 if False else '"just a string"\n'):
+        p = tmp_path / "alien.jsonl"
+        p.write_text(body)
+        with pytest.raises(ValueError):
+            load_trace(str(p))
+
+
+def test_v1_records_load_forward_compatibly(tmp_path):
+    """Unknown record fields from a NEWER minor writer are ignored,
+    never fatal — v1 readers keep working as the schema grows."""
+    p = tmp_path / "t.jsonl"
+    header = {"schema_version": SCHEMA_VERSION, "meta": {"rate": 10.0}}
+    rec = {"arrival_ts": 0.5, "tokens_in": 4, "max_tokens": 2,
+           "some_future_field": {"nested": True}, "lora_id": 7}
+    p.write_text(json.dumps(header) + "\n" + json.dumps(rec) + "\n")
+    tr = load_trace(str(p))
+    assert tr.skipped == 0
+    assert len(tr) == 1
+    assert tr.records[0].arrival_ts == 0.5
+    assert tr.records[0].tokens_in == 4
+    assert tr.meta == {"rate": 10.0}
+
+
+def test_malformed_lines_skipped_and_counted(tmp_path):
+    """One corrupt line in an hour of recorded traffic must not void
+    the rest: malformed records are dropped, counted in Trace.skipped,
+    and the good records around them still load."""
+    p = tmp_path / "t.jsonl"
+    good = {"arrival_ts": 1.0, "tokens_in": 8, "max_tokens": 4}
+    lines = [
+        json.dumps({"schema_version": SCHEMA_VERSION, "meta": {}}),
+        json.dumps(good),
+        "{truncated json",                       # unparseable
+        json.dumps([1, 2, 3]),                   # not an object
+        json.dumps({"tokens_in": 8, "max_tokens": 4}),  # missing field
+        json.dumps({"arrival_ts": "NaNope", "tokens_in": 1,
+                    "max_tokens": 1}),           # uncoercible type
+        "",                                      # blank lines are fine
+        json.dumps(dict(good, arrival_ts=2.0)),
+    ]
+    p.write_text("\n".join(lines) + "\n")
+    tr = load_trace(str(p))
+    assert tr.skipped == 4
+    assert [r.arrival_ts for r in tr.records] == [1.0, 2.0]
+
+
+# ----------------------------------------------------------- round-trip
+def test_save_load_save_is_bitwise(tmp_path):
+    """Serialization is deterministic (sorted keys, fixed separators):
+    generate -> save -> load -> save produces identical bytes, so traces
+    diff/hash cleanly as artifacts."""
+    recs = _records(8)
+    p1, p2 = tmp_path / "a.jsonl", tmp_path / "b.jsonl"
+    save_trace(str(p1), recs, meta={"seed": 0, "rate": 10.0})
+    tr = load_trace(str(p1))
+    assert tr.skipped == 0
+    save_trace(str(p2), tr.records, meta=tr.meta)
+    assert p1.read_bytes() == p2.read_bytes()
+    # and the loaded records are value-identical dataclasses
+    assert tr.records == recs
+
+
+def test_requests_roundtrip_preserves_shapes():
+    """records -> Requests -> records is lossless for everything the
+    twin prices (arrival, lengths, priority, deadline, rid, prompt)."""
+    recs = _records(6)
+    reqs = tracefmt.records_to_requests(recs)
+    back = tracefmt.requests_to_records(reqs)
+    assert back == recs
+
+
+# ----------------------------------------------------------- generators
+def test_poisson_records_match_legacy_inline_generator():
+    """The refactored benches must reproduce the pre-tracefmt arrival
+    sequences bitwise under a fixed seed: one exponential gap vector
+    first, then one prompt draw per request — the exact legacy order."""
+    n, rate, vocab, plen, max_new = 11, 20.0, 256, 4, 8
+    rng = np.random.default_rng(42)
+    arrivals = np.cumsum(rng.exponential(1.0 / rate, size=n))
+    legacy = [(float(arrivals[i]),
+               [int(t) for t in rng.integers(1, vocab, size=plen)])
+              for i in range(n)]
+    recs = poisson_records(np.random.default_rng(42), n, rate, vocab,
+                           plen, max_new)
+    assert [(r.arrival_ts, r.prompt) for r in recs] == legacy
+    assert all(r.rid == i for i, r in enumerate(recs))
+
+
+def test_burst_records_shape():
+    """burst_records = steady segment then a burst_factor x tail: the
+    burst rides after the steady window and arrives denser."""
+    rng = np.random.default_rng(1)
+    recs = burst_records(rng, 100, base_rate=2.0, burst_factor=10.0,
+                         burst_frac=0.25, vocab=64, prompt_len=4,
+                         max_new=4)
+    steady, burst = recs[:100], recs[100:]
+    assert len(burst) == 25
+    assert burst[0].arrival_ts > steady[-1].arrival_ts
+    ts = [r.arrival_ts for r in recs]
+    assert ts == sorted(ts)
+    gap_s = (steady[-1].arrival_ts - steady[0].arrival_ts) / 99
+    gap_b = (burst[-1].arrival_ts - burst[0].arrival_ts) / 24
+    assert gap_b < gap_s / 3  # ~10x the rate, generously bounded
+    assert [r.rid for r in recs] == list(range(125))
+
+
+def test_scale_rate_scales_offered_load():
+    """scale_rate(records, f) is the same arrival PROCESS at f x load:
+    timestamps divide by f, shapes and order are untouched. The
+    capacity-curve bisection sweeps exactly this knob."""
+    recs = _records(5)
+    fast = scale_rate(recs, 2.0)
+    for a, b in zip(recs, fast):
+        assert b.arrival_ts == pytest.approx(a.arrival_ts / 2.0)
+        assert (b.tokens_in, b.max_tokens, b.prompt) == \
+            (a.tokens_in, a.max_tokens, a.prompt)
+    # originals untouched (replace, not mutate)
+    assert recs == _records(5)
+    with pytest.raises(ValueError):
+        scale_rate(recs, 0.0)

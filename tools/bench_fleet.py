@@ -122,14 +122,13 @@ def _snapshot(cm, root, step):
 
 
 def _trace(rng, n, rate, vocab, prompt_len, max_new, priorities=(1,)):
-    from flexflow_tpu.serving import Request
-    arrivals = np.cumsum(rng.exponential(1.0 / rate, size=n))
-    return [Request(rid=i,
-                    prompt=list(rng.integers(1, vocab, size=prompt_len)),
-                    max_new_tokens=max_new,
-                    arrival_s=float(arrivals[i]),
-                    priority=priorities[i % len(priorities)])
-            for i in range(n)]
+    # tracefmt-backed (ISSUE 20): same rng draw order as the historical
+    # inline generator, so fixed seeds reproduce identical traces — and
+    # every fleet leg is save_trace()-able for twin replay.
+    from flexflow_tpu.serving import tracefmt
+    return tracefmt.records_to_requests(
+        tracefmt.poisson_records(rng, n, rate, vocab, prompt_len, max_new,
+                                 priorities=priorities))
 
 
 def _fleet(engines, floor=0.0, **kw):

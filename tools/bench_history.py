@@ -144,6 +144,14 @@ FAMILIES: Dict[str, Tuple[str, Callable[[Dict[str, Any]],
                     "ttft_budget_remaining", "burn_rate_1m", "shed_rate",
                     "legs_passed")
                    if d.get(k) is not None]),
+    "twin": (
+        r"^BENCH_twin\.json$",
+        lambda d: [(k, float(d[k])) for k in
+                   ("twin_vs_live_err", "capacity_rps_1",
+                    "capacity_scale2_x", "capacity_scale4_x",
+                    "autoscale_budget_at_signal",
+                    "autoscale_recommended_replicas", "legs_passed")
+                   if d.get(k) is not None]),
 }
 
 

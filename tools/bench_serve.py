@@ -72,16 +72,15 @@ def _build_engine(check: bool, kv_cache_dtype: str = "auto"):
 
 
 def _make_trace(rng, n_requests, rate, vocab, prompt_len, max_new):
-    """Open-loop Poisson arrivals: inter-arrival gaps ~ Exp(rate)."""
-    from flexflow_tpu.serving import Request
+    """Open-loop Poisson arrivals via tracefmt (ISSUE 20): the generator
+    IS the trace format, so every bench leg doubles as a replayable twin
+    scenario. Arrival/prompt rng order is the pre-tracefmt one — fixed
+    seeds reproduce the identical request sequence (pinned in tests)."""
+    from flexflow_tpu.serving import tracefmt
 
-    gaps = rng.exponential(1.0 / rate, size=n_requests)
-    arrivals = np.cumsum(gaps)
-    return [Request(rid=i,
-                    prompt=list(rng.integers(1, vocab, size=prompt_len)),
-                    max_new_tokens=max_new,
-                    arrival_s=float(arrivals[i]))
-            for i in range(n_requests)]
+    return tracefmt.records_to_requests(
+        tracefmt.poisson_records(rng, n_requests, rate, vocab, prompt_len,
+                                 max_new))
 
 
 def _run_leg(eng, gc, n_dev, rate, n_requests, seed):

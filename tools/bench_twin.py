@@ -1,0 +1,414 @@
+"""Capacity-twin benchmark: the ISSUE 20 evidence artifact.
+
+Three gated legs prove the twin earns its keep as ROADMAP item 5's
+config-by-simulation answer:
+
+  twin_vs_live — record REAL traffic: the gpt2 CPU twin serves an
+      open-loop Poisson trace with --serve-trace-out on, so the exact
+      offered load lands in a tracefmt JSONL. Replay that file through
+      the twin configured via `TwinSpec.from_engine` (structural drift
+      impossible by construction) with step/prefill costs calibrated
+      from the live run's own streaming histograms. Gate: twin
+      ttft_p99 and tokens/s/chip within 25% of the live values.
+      The same leg closes the calibration loop: the twin emits
+      residual rows (analytic prediction vs live measurement),
+      tools/refit_cost_model.py folds them into the corpus, and a
+      re-resolve prices from the refit `twin_*` kinds ("learned").
+  capacity — replicas -> max sustainable load by twin bisection over
+      `tracefmt.scale_rate`, priced at the SAME 100ms step floor
+      BENCH_fleet paces on. Gates: curve monotone in replicas, and the
+      2- and 4-replica capacity ratios consistent with BENCH_fleet's
+      measured weak scaling (scale2_x/scale4_x) within 35%.
+  autoscale — a 10x arrival burst against a 1-replica twin exhausts
+      the ttft error budget; the multi-window `scaling_signal` fires
+      scale_out BEFORE exhaustion (budget_remaining still > 0 at the
+      signal), the capacity curve sizes the response, and re-replaying
+      the same burst at the recommended replica count holds
+      budget_remaining > 0 end to end.
+
+  python tools/bench_twin.py                      # full bench
+  python tools/bench_twin.py --out BENCH_twin.json
+  python tools/bench_twin.py --check   # CI smoke: same legs, relaxed
+      twin-vs-live bound (CPU-timing jitter), no fleet-ratio gates
+
+Headline keys (bench_history "twin" family): twin_vs_live_err,
+capacity_rps_1, capacity_scale2_x, capacity_scale4_x,
+autoscale_budget_at_signal, autoscale_recommended_replicas, legs_passed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+
+# BENCH_fleet.json's measured weak scaling — the consistency anchor for
+# the capacity leg (re-read from the artifact when present).
+FLEET_SCALE2_X = 1.9679
+FLEET_SCALE4_X = 3.8604
+
+
+class Checks:
+    def __init__(self):
+        self.items = []
+
+    def add(self, name, ok, detail=""):
+        self.items.append({"check": name, "ok": bool(ok), "detail": detail})
+        if not ok:
+            print(f"CHECK FAIL: {name}: {detail}", file=sys.stderr)
+
+    def ok(self):
+        return all(c["ok"] for c in self.items)
+
+
+def _fleet_anchor():
+    """Prefer the committed BENCH_fleet.json scaling over the pinned
+    constants, so the two artifacts can never silently diverge."""
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "BENCH_fleet.json")
+    try:
+        with open(path) as f:
+            d = json.load(f)
+        return float(d["scale2_x"]), float(d["scale4_x"])
+    except Exception:  # noqa: BLE001 — artifact absent/old: pinned values
+        return FLEET_SCALE2_X, FLEET_SCALE4_X
+
+
+def _build_engine():
+    import jax
+
+    from flexflow_tpu import FFConfig, FFModel
+    from flexflow_tpu.models import GPT2Config, build_gpt2
+    from flexflow_tpu.serving import compile_serving
+
+    n_dev = len(jax.devices())
+    mesh = ({"data": 2, "model": n_dev // 2} if n_dev % 2 == 0 and n_dev > 1
+            else {"data": max(1, n_dev)})
+    cfg = FFConfig(search_budget=16, mesh_shape=mesh, log_level="warning",
+                   max_batch_slots=4, kv_page_size=4)
+    gc = GPT2Config(vocab=256, seq=16, d_model=64, heads=2, layers=1,
+                    dropout=0.0)
+    m = FFModel(cfg)
+    build_gpt2(m, gc, batch=8)
+    eng = compile_serving(m, max_decode_len=4)
+    eng.init(seed=0)
+    return eng, gc, n_dev
+
+
+def _serve(eng, reqs, trace_out=""):
+    """One scheduler run; optionally exporting the offered load as a
+    tracefmt JSONL via the --serve-trace-out path."""
+    from flexflow_tpu.serving import (ContinuousBatchingScheduler,
+                                      gpt2_prompt_inputs, gpt2_step_inputs)
+    prev = getattr(eng.cfg, "serve_trace_out", "")
+    eng.cfg.serve_trace_out = trace_out
+    try:
+        sched = ContinuousBatchingScheduler(
+            eng, eng.params, gpt2_prompt_inputs, gpt2_step_inputs,
+            eos_id=None, dispatch_ahead=4)
+        t0 = time.perf_counter()
+        done = sched.run(reqs)
+        wall = time.perf_counter() - t0
+    finally:
+        eng.cfg.serve_trace_out = prev
+    return sched, done, wall
+
+
+# ------------------------------------------------------------------ leg 1
+def leg_twin_vs_live(checks, seed, bound, n_requests=80, overload=3.0):
+    """Live run -> recorded trace -> twin replay -> report diff, plus the
+    residual -> refit -> learned-pricing round trip.
+
+    The recorded run is driven at `overload` x the engine's MEASURED
+    service capacity (probed with a closed burst after compile warmup):
+    in that regime ttft_p99 is set by deterministic queue backlog —
+    which the twin replays — in the 100ms-to-seconds range, instead of
+    by single-step host-OS stragglers that swamp a 25% bound when the
+    tiny CPU twin is unloaded and TTFTs sit at ~20ms.
+
+    Calibration assumes the host is stationary across probe and record,
+    so the record is BRACKETED by two identical probes: if their walls
+    disagree by >20% the machine shifted mid-leg (shared-host CPU
+    contention) and the recording is retried — the retry decision never
+    looks at the gated metrics."""
+    from flexflow_tpu import telemetry as tel
+    from flexflow_tpu.serving import tracefmt
+    from flexflow_tpu.serving.twin import (TwinCosts, TwinSpec,
+                                           calibrate_window_overhead,
+                                           emit_residual_rows, simulate,
+                                           validate)
+    import refit_cost_model
+
+    eng, gc, n_dev = _build_engine()
+    rng = np.random.default_rng(seed)
+    mk = lambda n, r: tracefmt.records_to_requests(  # noqa: E731
+        tracefmt.poisson_records(rng, n, r, gc.vocab, 4,
+                                 eng.max_decode_len))
+    _serve(eng, mk(8, 500.0))  # compile-warm: keep JIT out of the record
+    # saturated probe trace: measures service capacity AND the live wall
+    # the window-overhead calibration solves against
+    probe_recs = tracefmt.poisson_records(rng, 24, 1000.0, gc.vocab, 4,
+                                          eng.max_decode_len)
+
+    out = {}
+    with tempfile.TemporaryDirectory() as td:
+        trace_path = os.path.join(td, "live_trace.jsonl")
+        for attempt in range(3):
+            _, p1_done, p1_wall = _serve(
+                eng, tracefmt.records_to_requests(probe_recs))
+            rate = overload * len(p1_done) / p1_wall
+            sched, done, wall = _serve(eng, mk(n_requests, rate),
+                                       trace_out=trace_path)
+            _, _, p2_wall = _serve(
+                eng, tracefmt.records_to_requests(probe_recs))
+            drift = abs(p1_wall - p2_wall) / min(p1_wall, p2_wall)
+            if drift <= 0.20:
+                break
+            print(f"bench_twin: host shifted mid-record "
+                  f"(probe walls {p1_wall:.3f}s/{p2_wall:.3f}s, "
+                  f"attempt {attempt + 1}) — retrying", file=sys.stderr)
+        probe_wall = (p1_wall + p2_wall) / 2.0
+        toks = sum(len(r.tokens) for r in done)
+        live_hists = sched.tracer.hists if sched.tracer else {}
+        live = {
+            "tokens_per_s_per_chip": toks / wall / n_dev,
+            "ttft_p99_s": live_hists["ttft"].quantile(0.99),
+        }
+
+        trace = tracefmt.load_trace(trace_path)
+        checks.add("trace_export_roundtrip",
+                   len(trace) == n_requests and trace.skipped == 0
+                   and trace.meta.get("source") == "scheduler",
+                   f"{len(trace)}/{n_requests} records, "
+                   f"meta={trace.meta}")
+
+        spec = TwinSpec.from_engine(eng, replicas=1)
+        ks = spec.kv_spec()
+        # pin pricing inputs: no ambient ~/.cache model may leak in
+        eng.cfg.cost_model_path = os.path.join(td, "model.json")
+        analytic = TwinCosts.analytic(ks)
+        live_report = {"hists": live_hists}
+        costs = TwinCosts.resolve(ks, cfg=eng.cfg, live_report=live_report,
+                                  slots=spec.slots)
+        costs.window_overhead_s = calibrate_window_overhead(
+            probe_recs, spec, costs, probe_wall)
+        checks.add("costs_calibrated_from_live", costs.source == "measured",
+                   f"source={costs.source}")
+        sim = simulate(trace.records, spec, costs)
+        twin = {
+            "tokens_per_s_per_chip": sim.stats["tokens_per_s"] / n_dev,
+            "ttft_p99_s": sim.hists["ttft"].quantile(0.99),
+        }
+        val = validate(live, twin, max_rel_err=bound)
+        checks.add("twin_vs_live_within_bound", val["ok"],
+                   f"max_rel_err={val['max_rel_err']:.3f} > {bound}")
+        checks.add("twin_completed_all",
+                   sim.stats["completed"] == n_requests
+                   and sim.stats["shed"] == 0, str(sim.stats))
+
+        # residual -> refit -> learned: the self-calibration loop
+        tdir = os.path.join(td, "tel")
+        tel.configure(tdir)
+        rows = emit_residual_rows(live_report, analytic, ks, spec.slots)
+        tel.flush()
+        tel.shutdown()
+        refit = refit_cost_model.refit(tdir, model_path=eng.cfg.
+                                       cost_model_path, quiet=True)
+        checks.add("residual_rows_refit",
+                   rows == 2 and refit is not None
+                   and int((refit or {}).get("rows") or 0) >= 2,
+                   f"rows={rows} refit={refit}")
+        relearned = TwinCosts.resolve(ks, cfg=eng.cfg, slots=spec.slots)
+        meas = live_hists["decode_step"].mean()
+        step_err = abs(relearned.decode_step_s - meas) / max(meas, 1e-12)
+        checks.add("refit_prices_twin_kinds",
+                   relearned.source == "learned" and step_err <= 0.10,
+                   f"source={relearned.source} step_err={step_err:.3f}")
+        out = {
+            "devices": n_dev, "requests": n_requests,
+            "arrival_rate_req_s": rate, "overload_x": overload,
+            "live": val["metrics"],
+            "max_rel_err": val["max_rel_err"], "bound": bound,
+            "priced_by": costs.source,
+            "decode_step_s": costs.decode_step_s,
+            "prefill_base_s": costs.prefill_base_s,
+            "window_overhead_s": costs.window_overhead_s,
+            "residual_rows": rows,
+            "refit_rows": int((refit or {}).get("rows") or 0),
+            "relearned_source": relearned.source,
+            "twin_stats": sim.stats,
+        }
+    return out
+
+
+# ------------------------------------------------------------------ leg 2
+def leg_capacity(checks, seed, gate_ratios, tol=0.35):
+    """Twin capacity curve under BENCH_fleet's pacing regime, anchored to
+    the fleet's MEASURED weak scaling."""
+    from flexflow_tpu.serving import tracefmt
+    from flexflow_tpu.serving.twin import TwinCosts, TwinSpec, capacity_curve
+
+    rng = np.random.default_rng(seed)
+    # A loose latency target (like the fleet bench, which has none):
+    # feasibility binds on the drain criterion, so the curve measures
+    # THROUGHPUT scaling — the quantity BENCH_fleet's scale2/4_x anchor.
+    recs = tracefmt.poisson_records(rng, 240, 10.0, 256, 4, 4)
+    spec = TwinSpec(replicas=1, slots=4, seq=16, page_size=4,
+                    max_decode_len=4, slo="ttft_p99_ms=30000")
+    costs = TwinCosts.analytic(spec.kv_spec(), step_floor_s=0.1)
+    curve = capacity_curve(recs, spec, costs, replicas=(1, 2, 4))
+    caps = [c["capacity_rps"] for c in curve]
+    checks.add("capacity_curve_monotone",
+               len(caps) == 3 and caps[0] > 0
+               and caps[0] < caps[1] < caps[2], f"caps={caps}")
+    s2, s4 = caps[1] / caps[0], caps[2] / caps[0]
+    f2, f4 = _fleet_anchor()
+    out = {"step_floor_s": 0.1, "curve": curve,
+           "scale2_x": s2, "scale4_x": s4,
+           "fleet_scale2_x": f2, "fleet_scale4_x": f4,
+           "tolerance": tol}
+    if gate_ratios:
+        checks.add("capacity_scale2_matches_fleet",
+                   abs(s2 - f2) / f2 <= tol,
+                   f"twin {s2:.2f} vs fleet {f2:.2f}")
+        checks.add("capacity_scale4_matches_fleet",
+                   abs(s4 - f4) / f4 <= tol,
+                   f"twin {s4:.2f} vs fleet {f4:.2f}")
+    return out
+
+
+# ------------------------------------------------------------------ leg 3
+def _min_budget(res):
+    rep = res.slo.report(now_s=res.stats["wall_s"])
+    budgets = [o.get("budget_remaining")
+               for o in (rep.get("objectives") or {}).values()]
+    budgets = [b for b in budgets if b is not None]
+    return min(budgets) if budgets else None
+
+
+def _peak_rps(recs, window_s=10.0):
+    ts = sorted(r.arrival_ts for r in recs)
+    peak, lo = 0, 0
+    for hi, t in enumerate(ts):
+        while ts[lo] < t - window_s:
+            lo += 1
+        peak = max(peak, hi - lo + 1)
+    return peak / window_s
+
+
+def leg_autoscale(checks, seed):
+    """10x burst: static 1-replica config exhausts the error budget; the
+    twin's scaling signal fires scale_out while budget is still positive;
+    the capacity curve sizes the fleet; the sized fleet holds budget."""
+    from flexflow_tpu.serving import tracefmt
+    from flexflow_tpu.serving.twin import (TwinCosts, TwinSpec,
+                                           capacity_curve, simulate)
+
+    rng = np.random.default_rng(seed)
+    # ~20min of steady 1 req/s history, then a 10x burst (~30s at
+    # 10 req/s) — history long relative to the burn windows is what lets
+    # the windowed burn cross the alert threshold while the cumulative
+    # budget is still positive (the point of multi-window burn alerting).
+    recs = tracefmt.burst_records(rng, 1200, 1.0, 10.0, 0.25, 256, 4, 8)
+    spec = TwinSpec(replicas=1, slots=4, seq=16, page_size=4,
+                    max_decode_len=8, slo="ttft_p95_ms=1000")
+    costs = TwinCosts.analytic(spec.kv_spec(), step_floor_s=0.1)
+
+    static = simulate(recs, spec, costs, signal_every_s=5.0)
+    static_budget = _min_budget(static)
+    checks.add("static_burst_exhausts_budget",
+               static_budget is not None and static_budget <= 0.0,
+               f"budget_remaining={static_budget}")
+    sig = next((s for s in static.signals if s["action"] == "scale_out"),
+               None)
+    checks.add("scale_out_before_exhaustion",
+               sig is not None and (sig.get("budget_remaining") or 0) > 0,
+               f"signal={sig}")
+
+    # size the response off the steady-state capacity curve vs the
+    # observed peak arrival rate (15% headroom)
+    steady = recs[:1200]
+    curve = capacity_curve(steady, spec, costs, replicas=(1, 2, 4, 8))
+    peak = _peak_rps(recs)
+    rec_n = next((c["replicas"] for c in curve
+                  if c["capacity_rps"] >= 1.15 * peak),
+                 curve[-1]["replicas"] if curve else 1)
+    scaled = simulate(recs, dataclasses.replace(spec, replicas=rec_n),
+                      costs)
+    scaled_budget = _min_budget(scaled)
+    checks.add("scaled_holds_budget",
+               scaled_budget is not None and scaled_budget > 0.0
+               and scaled.stats["shed"] == 0,
+               f"replicas={rec_n} budget_remaining={scaled_budget} "
+               f"shed={scaled.stats['shed']}")
+    return {"requests": len(recs), "peak_rps": peak,
+            "static_budget_remaining": static_budget,
+            "signal": sig, "signals": static.signals,
+            "capacity_curve": curve,
+            "recommended_replicas": rec_n,
+            "scaled_budget_remaining": scaled_budget,
+            "budget_at_signal": (sig or {}).get("budget_remaining")}
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser("bench_twin")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--requests", type=int, default=80,
+                   help="live-leg request count")
+    p.add_argument("--overload", type=float, default=3.0,
+                   help="live-leg arrival rate as a multiple of the "
+                        "probed service capacity (queueing-dominated)")
+    p.add_argument("--bound", type=float, default=0.25,
+                   help="twin-vs-live max relative error gate")
+    p.add_argument("--out", default="", help="also write the JSON here")
+    p.add_argument("--check", action="store_true",
+                   help="CI smoke: relaxed twin-vs-live bound (CPU timing "
+                        "jitter), no fleet-ratio gates")
+    args = p.parse_args(argv)
+    bound = max(args.bound, 0.5) if args.check else args.bound
+
+    checks = Checks()
+    live = leg_twin_vs_live(checks, args.seed + 1, bound,
+                            n_requests=args.requests,
+                            overload=args.overload)
+    capacity = leg_capacity(checks, args.seed + 2,
+                            gate_ratios=not args.check)
+    autoscale = leg_autoscale(checks, args.seed + 3)
+
+    report = {
+        "model": "gpt2 CPU twin" + (" (check)" if args.check else ""),
+        "devices": live.get("devices"),
+        "legs": {"twin_vs_live": live, "capacity": capacity,
+                 "autoscale": autoscale},
+        "checks": checks.items,
+        # headline metrics (bench_history "twin" family)
+        "twin_vs_live_err": live.get("max_rel_err"),
+        "capacity_rps_1": capacity["curve"][0]["capacity_rps"],
+        "capacity_scale2_x": capacity["scale2_x"],
+        "capacity_scale4_x": capacity["scale4_x"],
+        "autoscale_budget_at_signal": autoscale["budget_at_signal"],
+        "autoscale_recommended_replicas": autoscale["recommended_replicas"],
+        "legs_passed": sum(c["ok"] for c in checks.items),
+    }
+    print(json.dumps(report, indent=1, default=float))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=1, default=float)
+    print("CHECK " + ("PASS" if checks.ok() else "FAIL"))
+    return 0 if checks.ok() else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

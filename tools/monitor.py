@@ -175,6 +175,19 @@ def gather(events: List[Dict[str, Any]]) -> Dict[str, Any]:
 
 
 # ------------------------------------------------------------------- render
+def load_twin(path: Optional[str]) -> Optional[Dict[str, Any]]:
+    """Read a tools/twin.py report (--twin-out) for the twin panel;
+    tolerant of a missing/partial file (the twin may be re-running)."""
+    if not path:
+        return None
+    try:
+        with open(path) as f:
+            d = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return d if isinstance(d, dict) and d.get("stats") else None
+
+
 def _bar(frac: float, width: int = 30) -> str:
     frac = max(0.0, min(1.0, frac))
     n = int(round(frac * width))
@@ -412,6 +425,37 @@ def render(state: Dict[str, Any]) -> List[str]:
                     f"slots={r.get('active_slots', 0)} "
                     f"queue={r.get('queue_depth', 0)} "
                     f"v{r.get('swap_version') if r.get('swap_version') is not None else '-'}")
+    tw = state.get("twin")
+    if tw:
+        # ISSUE 20: capacity-twin panel — what the replayed trace says
+        # about this config, plus the burn-driven scaling recommendation
+        # and the replicas -> capacity curve from twin bisection
+        st = tw.get("stats") or {}
+        ttft = ((tw.get("hists") or {}).get("ttft") or {}).get("p99")
+        lines.append(
+            f"twin     {st.get('replicas', '?')} replicas "
+            f"({st.get('topology', '?')}, priced {tw.get('priced_by', '?')})"
+            f"  {float(st.get('tokens_per_s', 0.0)):.1f} tok/s"
+            + (f"  ttft p99 {ttft:.3f}s" if ttft is not None else ""))
+        lines.append(
+            f"         replayed {st.get('requests', 0)} reqs: "
+            f"done={st.get('completed', 0)} shed={st.get('shed', 0)} "
+            f"handoffs={st.get('handoffs', 0)} "
+            f"wall {float(st.get('wall_s', 0.0)):.1f}s (virtual)")
+        sc = tw.get("scaling") or {}
+        if sc.get("action"):
+            bud = sc.get("budget_remaining")
+            lines.append(
+                f"         scaling: {sc['action']}"
+                + (f" [{sc.get('objective')}]" if sc.get("objective")
+                   else "")
+                + (f" budget={100.0 * bud:.1f}%" if bud is not None else "")
+                + f" — {sc.get('reason', '')}")
+        curve = tw.get("capacity_curve") or []
+        if curve:
+            lines.append("capacity " + "  ".join(
+                f"{c['replicas']}r={float(c['capacity_rps']):.1f}rps"
+                for c in curve))
     sent = state["sentinels"]
     bad = sent["nonfinite"] or state["halts"]
     status = "FATAL" if bad else (
@@ -640,6 +684,49 @@ def prom_export(state: Dict[str, Any], path: str) -> None:
                     g.append('%s{replica="%d",role="%s"} %g'
                              % (name, idx, r.get("role", "?"),
                                 float(r[key])))
+    tw = state.get("twin")
+    if tw:
+        # ISSUE 20: capacity-twin gauges — the twin's replay verdict and
+        # scaling recommendation, scrapeable next to the live series
+        st = tw.get("stats") or {}
+        gauge("flexflow_twin_replicas", float(st.get("replicas", 0)),
+              "Replica count of the replayed twin scenario")
+        gauge("flexflow_twin_tokens_per_second",
+              float(st.get("tokens_per_s", 0.0)),
+              "Twin-predicted serving throughput for the replayed trace")
+        gauge("flexflow_twin_completed_total",
+              float(st.get("completed", 0)),
+              "Requests the twin replay completed")
+        gauge("flexflow_twin_shed_total", float(st.get("shed", 0)),
+              "Requests the twin replay shed")
+        ttft = ((tw.get("hists") or {}).get("ttft") or {}).get("p99")
+        if ttft is not None:
+            gauge("flexflow_twin_ttft_p99_seconds", float(ttft),
+                  "Twin-predicted TTFT p99 for the replayed trace")
+        sc = tw.get("scaling") or {}
+        if sc.get("budget_remaining") is not None:
+            gauge("flexflow_twin_budget_remaining",
+                  float(sc["budget_remaining"]),
+                  "Worst remaining SLO error budget in the twin replay")
+        if sc.get("worst_burn_rate") is not None:
+            gauge("flexflow_twin_worst_burn_rate",
+                  float(sc["worst_burn_rate"]),
+                  "Worst SLO burn rate in the twin replay")
+        if sc.get("action"):
+            g.append("# HELP flexflow_twin_scaling_info Twin scaling "
+                     "recommendation (action as label)")
+            g.append("# TYPE flexflow_twin_scaling_info gauge")
+            g.append('flexflow_twin_scaling_info{action="%s"} 1'
+                     % sc["action"])
+        curve = tw.get("capacity_curve") or []
+        if curve:
+            g.append("# HELP flexflow_twin_capacity_rps Max sustainable "
+                     "offered load at SLO by twin bisection, per replica "
+                     "count")
+            g.append("# TYPE flexflow_twin_capacity_rps gauge")
+            for c in curve:
+                g.append('flexflow_twin_capacity_rps{replicas="%d"} %g'
+                         % (int(c["replicas"]), float(c["capacity_rps"])))
     tmp = path + ".tmp"
     with open(tmp, "w") as f:
         f.write("\n".join(g) + "\n")
@@ -648,8 +735,10 @@ def prom_export(state: Dict[str, Any], path: str) -> None:
 
 # --------------------------------------------------------------------- main
 def run_once(telemetry_dir: str, prom_file: Optional[str] = None,
-             clear: bool = False) -> Dict[str, Any]:
+             clear: bool = False,
+             twin_report: Optional[str] = None) -> Dict[str, Any]:
     state = gather(load_events(telemetry_dir))
+    state["twin"] = load_twin(twin_report)
     out = render(state)
     if clear:
         sys.stdout.write("\x1b[2J\x1b[H")
@@ -687,14 +776,32 @@ def _check() -> int:
         from flexflow_tpu import telemetry as tel
 
         tel.shutdown()
+        # ISSUE 20: a twin report feeds the twin panel + gauges
+        from flexflow_tpu.serving import tracefmt
+        from flexflow_tpu.serving.twin import TwinCosts, TwinSpec, simulate
+
+        trng = np.random.default_rng(0)
+        recs = tracefmt.poisson_records(trng, 16, 10.0, 64, 4, 4)
+        tspec = TwinSpec(replicas=2, slots=4, seq=16, page_size=4,
+                         max_decode_len=4, slo="ttft_p99_ms=500")
+        trep = simulate(recs, tspec,
+                        TwinCosts.analytic(tspec.kv_spec())).report()
+        trep["capacity_curve"] = [{"replicas": 1, "capacity_rps": 10.0},
+                                  {"replicas": 2, "capacity_rps": 20.0}]
+        twin_path = os.path.join(td, "twin.json")
+        with open(twin_path, "w") as f:
+            json.dump(trep, f, default=float)
         prom = os.path.join(td, "flexflow.prom")
-        state = run_once(tdir, prom_file=prom)
+        state = run_once(tdir, prom_file=prom, twin_report=twin_path)
         ok = (len(state["goodputs"]) == 2
               and state["sentinels"]["nonfinite"] == 0
               and os.path.exists(prom))
         if ok:
             with open(prom) as f:
-                ok = "flexflow_goodput_ratio" in f.read()
+                text = f.read()
+            ok = ("flexflow_goodput_ratio" in text
+                  and "flexflow_twin_tokens_per_second" in text
+                  and 'flexflow_twin_capacity_rps{replicas="2"}' in text)
     print("CHECK " + ("PASS" if ok else "FAIL"))
     return 0 if ok else 1
 
@@ -712,6 +819,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--prom-file", default=None,
                     help="write a Prometheus textfile export here on "
                     "every refresh")
+    ap.add_argument("--twin-report", default=None,
+                    help="tools/twin.py report JSON (--twin-out) to "
+                    "render as the capacity-twin panel + "
+                    "flexflow_twin_* gauges (re-read every refresh)")
     ap.add_argument("--json", action="store_true",
                     help="with --once: dump the gathered state as JSON "
                     "instead of the dashboard")
@@ -725,16 +836,19 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.once:
         if args.json:
             state = gather(load_events(args.telemetry_dir))
+            state["twin"] = load_twin(args.twin_report)
             if args.prom_file:
                 prom_export(state, args.prom_file)
             print(json.dumps(state, indent=2, default=str))
         else:
-            run_once(args.telemetry_dir, args.prom_file)
+            run_once(args.telemetry_dir, args.prom_file,
+                     twin_report=args.twin_report)
         return 0
     n = 0
     try:
         while True:
-            run_once(args.telemetry_dir, args.prom_file, clear=True)
+            run_once(args.telemetry_dir, args.prom_file, clear=True,
+                     twin_report=args.twin_report)
             n += 1
             if args.iterations and n >= args.iterations:
                 break
